@@ -1,0 +1,115 @@
+"""Differential tests: core codecs vs the reference implementation."""
+
+import random
+
+import pytest
+
+from upow_tpu.core import codecs, curve
+from ref_loader import load_reference
+
+ref = load_reference()
+
+
+def random_points(n, seed=1234):
+    rng = random.Random(seed)
+    return [curve.point_mul(rng.randrange(1, curve.CURVE_N), curve.G) for _ in range(n)]
+
+
+POINTS = random_points(20)
+
+
+def test_sha256_hex_semantics():
+    assert codecs.sha256_hex("00ff") == ref.helpers.sha256("00ff")
+    assert codecs.sha256_hex(b"\x00\xff") == ref.helpers.sha256(b"\x00\xff")
+    assert codecs.sha256_hex(b"") == ref.helpers.sha256(b"")
+
+
+@pytest.mark.parametrize("idx", range(len(POINTS)))
+def test_address_codecs_match_reference(idx):
+    x, y = POINTS[idx]
+    ref_point = ref.helpers.Point(x, y) if hasattr(ref.helpers, "Point") else None
+    from fastecdsa.point import Point as RefPoint  # shimmed
+
+    rp = RefPoint(x, y)
+    # compressed (base58) and full-hex strings
+    assert codecs.point_to_string((x, y), codecs.AddressFormat.COMPRESSED) == \
+        ref.helpers.point_to_string(rp, ref.helpers.AddressFormat.COMPRESSED)
+    assert codecs.point_to_string((x, y), codecs.AddressFormat.FULL_HEX) == \
+        ref.helpers.point_to_string(rp, ref.helpers.AddressFormat.FULL_HEX)
+    # bytes forms
+    assert codecs.point_to_bytes((x, y)) == ref.helpers.point_to_bytes(rp)
+    # round trips through both codebases
+    compressed = codecs.point_to_string((x, y))
+    assert codecs.string_to_point(compressed) == (x, y)
+    ref_pt = ref.helpers.string_to_point(compressed)
+    assert (ref_pt.x, ref_pt.y) == (x, y)
+    full = codecs.point_to_string((x, y), codecs.AddressFormat.FULL_HEX)
+    assert codecs.string_to_point(full) == (x, y)
+
+
+def test_x_to_y_decompression():
+    for x, y in POINTS:
+        assert codecs.x_to_y(x, bool(y % 2)) == y
+        assert codecs.x_to_y(x, y % 2 == 1) == y
+
+
+def test_bytes_to_string_roundtrip():
+    for x, y in POINTS[:5]:
+        b33 = codecs.point_to_bytes((x, y), codecs.AddressFormat.COMPRESSED)
+        b64 = codecs.point_to_bytes((x, y), codecs.AddressFormat.FULL_HEX)
+        assert codecs.string_to_bytes(codecs.bytes_to_string(b33)) == b33
+        assert codecs.string_to_bytes(codecs.bytes_to_string(b64)) == b64
+        assert codecs.bytes_to_string(b33) == ref.helpers.bytes_to_string(b33)
+        assert codecs.bytes_to_string(b64) == ref.helpers.bytes_to_string(b64)
+
+
+def test_base58_vectors():
+    vectors = [b"", b"\x00", b"\x00\x00abc", b"hello world", bytes(range(33))]
+    for v in vectors:
+        enc = codecs.b58encode(v)
+        assert codecs.b58decode(enc) == v
+
+
+def test_transaction_type_from_message():
+    cases = [None, b"0", b"4", b"5", b"6", b"7", b"8", b"9", b"1", b"2",
+             b"junk", b"\xff\xfe", b"06", b" 6", b"10"]
+    for message in cases:
+        ours = codecs.transaction_type_from_message(message)
+        theirs = ref.helpers.get_transaction_type_from_message(message)
+        assert ours == theirs, f"mismatch for {message!r}: {ours} vs {theirs}"
+
+
+def test_ecdsa_against_openssl():
+    """Our P-256 ECDSA interoperates with OpenSSL (cryptography package)."""
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+
+    d, pub = curve.keygen(rng=0xDEADBEEFCAFE)
+    msg = b"upow tpu differential test"
+
+    # ours -> OpenSSL verifies
+    r, s = curve.sign(msg, d)
+    openssl_pub = ec.EllipticCurvePublicNumbers(pub[0], pub[1], ec.SECP256R1()).public_key()
+    openssl_pub.verify(encode_dss_signature(r, s), msg, ec.ECDSA(hashes.SHA256()))
+
+    # OpenSSL -> ours verifies
+    openssl_priv = ec.derive_private_key(d, ec.SECP256R1())
+    der = openssl_priv.sign(msg, ec.ECDSA(hashes.SHA256()))
+    r2, s2 = decode_dss_signature(der)
+    assert curve.verify((r2, s2), msg, pub)
+    assert not curve.verify((r2, s2), msg + b"!", pub)
+    assert not curve.verify((r2, (s2 + 1) % curve.CURVE_N), msg, pub)
+
+
+def test_invalid_64byte_address_rejected_like_reference():
+    """Off-curve 64-byte addresses must be rejected at decode time, the way
+    fastecdsa's Point constructor rejects them in the reference."""
+    bad = (123).to_bytes(32, "little") + (456).to_bytes(32, "little")
+    with pytest.raises(ValueError):
+        codecs.bytes_to_point(bad)
+    with pytest.raises(ValueError):
+        ref.helpers.bytes_to_point(bad)

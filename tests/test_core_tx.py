@@ -1,0 +1,159 @@
+"""Differential tests: transaction wire codec vs the reference."""
+
+import random
+from decimal import Decimal
+
+import pytest
+
+from upow_tpu.core import codecs, curve
+from upow_tpu.core.constants import SMALLEST
+from upow_tpu.core.tx import CoinbaseTx, Tx, TxInput, TxOutput, tx_from_hex
+from ref_loader import load_reference
+
+ref = load_reference()
+rng = random.Random(99)
+
+KEYS = [rng.randrange(1, curve.CURVE_N) for _ in range(4)]
+PUBS = [curve.point_mul(d, curve.G) for d in KEYS]
+ADDRS_C = [codecs.point_to_string(p) for p in PUBS]
+ADDRS_H = [codecs.point_to_string(p, codecs.AddressFormat.FULL_HEX) for p in PUBS]
+
+
+def make_pair(addrs, message=None, n_in=2, n_out=2, amounts=None, types=None, seed=7):
+    """Build the same tx in both codebases; returns (ours, theirs)."""
+    r = random.Random(seed)
+    in_specs = [(r.getrandbits(256).to_bytes(32, "big").hex(), r.randrange(0, 10)) for _ in range(n_in)]
+    amounts = amounts or [r.randrange(1, 10 ** 12) for _ in range(n_out)]
+    types = types or [codecs.OutputType.REGULAR] * n_out
+
+    ours = Tx(
+        [TxInput(h, i) for h, i in in_specs],
+        [TxOutput(addrs[k % len(addrs)], amounts[k], types[k]) for k in range(n_out)],
+        message=message,
+    )
+    theirs = ref.Transaction(
+        [ref.TransactionInput(h, i) for h, i in in_specs],
+        [
+            ref.TransactionOutput(
+                addrs[k % len(addrs)],
+                Decimal(amounts[k]) / SMALLEST,
+                ref.helpers.OutputType(int(types[k])),
+            )
+            for k in range(n_out)
+        ],
+        message=message,
+    )
+    return ours, theirs
+
+
+def sign_both(ours, theirs, keys=None):
+    keys = keys or KEYS
+    signing_bytes = bytes.fromhex(ours.hex(False))
+    for k, tx_input in enumerate(ours.inputs):
+        tx_input.signature = curve.sign(signing_bytes, keys[k % len(keys)])
+    for k, tx_input in enumerate(theirs.inputs):
+        tx_input.signed = curve.sign(bytes.fromhex(theirs.hex(False)), keys[k % len(keys)])
+    return ours, theirs
+
+
+@pytest.mark.parametrize("addrs", [ADDRS_C, ADDRS_H], ids=["compressed-v3", "fullhex-v1"])
+@pytest.mark.parametrize("message", [None, b"0", b"7", b"some memo bytes"])
+def test_unsigned_hex_matches(addrs, message):
+    ours, theirs = make_pair(addrs, message=message)
+    assert ours.hex(False) == theirs.hex(False)
+    assert ours.version == theirs.version
+
+
+@pytest.mark.parametrize("addrs", [ADDRS_C, ADDRS_H], ids=["compressed-v3", "fullhex-v1"])
+@pytest.mark.parametrize("message", [None, b"6"])
+def test_signed_hex_and_hash_match(addrs, message):
+    ours, theirs = make_pair(addrs, message=message, n_in=3, seed=21)
+    sign_both(ours, theirs)
+    assert ours.hex() == theirs.hex()
+    assert ours.hash() == theirs.hash()
+
+
+def test_signature_dedup_single_key():
+    """All inputs signed by the same key -> one signature on the wire."""
+    ours, theirs = make_pair(ADDRS_C, n_in=3, seed=33)
+    sign_both(ours, theirs, keys=[KEYS[0]])
+    assert ours.hex() == theirs.hex()
+    # one 64-byte signature after the message specifier
+    unsigned_len = len(ours.hex(False))
+    assert len(ours.hex()) == unsigned_len + 2 + 128  # specifier byte + 1 sig
+
+
+def test_from_hex_roundtrip():
+    ours, theirs = make_pair(ADDRS_C, message=b"7", n_in=2, n_out=3, seed=5)
+    sign_both(ours, theirs)
+    wire = ours.hex()
+    decoded = tx_from_hex(wire)
+    assert decoded.hex() == wire
+    assert [i.outpoint for i in decoded.inputs] == [i.outpoint for i in ours.inputs]
+    assert [o.amount for o in decoded.outputs] == [o.amount for o in ours.outputs]
+    assert [o.output_type for o in decoded.outputs] == [o.output_type for o in ours.outputs]
+    assert decoded.message == b"7"
+    assert decoded.transaction_type == codecs.TransactionType.VOTE_AS_DELEGATE
+
+
+def test_from_hex_matches_reference_decode():
+    import asyncio
+
+    ours, theirs = make_pair(ADDRS_H, message=None, n_in=2, n_out=2, seed=13)
+    sign_both(ours, theirs)
+    wire = ours.hex()
+    ref_decoded = asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+        ref.Transaction.from_hex(wire, check_signatures=False)
+    )
+    assert ref_decoded.hex(False) == tx_from_hex(wire, check_signatures=False).hex(False)
+
+
+def test_coinbase_hex_matches():
+    block_hash = codecs.sha256_hex(b"some block")
+    amount = 3 * SMALLEST
+    ours = CoinbaseTx(block_hash, ADDRS_C[0], amount)
+    theirs = ref.CoinbaseTransaction(block_hash, ADDRS_C[0], Decimal(amount) / SMALLEST)
+    assert ours.hex() == theirs.hex()
+    assert ours.hash() == theirs.hash()
+    # multi-output (inode rewards appended)
+    ours.outputs.append(TxOutput(ADDRS_C[1], SMALLEST // 2))
+    theirs.outputs.append(ref.TransactionOutput(ADDRS_C[1], Decimal("0.5")))
+    ours._hex = None
+    theirs._hex = None
+    assert ours.hex() == theirs.hex()
+    decoded = tx_from_hex(ours.hex())
+    assert decoded.is_coinbase and decoded.hex() == ours.hex()
+
+
+def test_amount_encoding_boundaries():
+    for amount in [1, 255, 256, 65535, 65536, 10 ** 10, 6 * SMALLEST]:
+        ours, theirs = make_pair(ADDRS_C, amounts=[amount, amount], seed=amount % 1000)
+        assert ours.hex(False) == theirs.hex(False)
+
+
+def test_input_limits():
+    with pytest.raises(ValueError):
+        Tx([TxInput("00" * 32, 0)] * 256, [TxOutput(ADDRS_C[0], 1)])
+    with pytest.raises(ValueError):
+        Tx([TxInput("00" * 32, 0)], [TxOutput(ADDRS_C[0], 1)] * 256)
+
+
+def test_output_verify():
+    good = TxOutput(ADDRS_C[0], 5)
+    assert good.verify()
+    assert not TxOutput(ADDRS_C[0], 0).verify()
+
+
+def test_fees_match_reference_semantics():
+    ours, _ = make_pair(ADDRS_C, n_in=1, n_out=2, amounts=[100, 50], seed=77)
+    # input resolved to 200 smallest units by the state view
+    assert ours.fees(input_amount=200) == 50
+    # voting-power outputs excluded from the fee sum
+    ours2 = Tx(
+        [TxInput("11" * 32, 0)],
+        [
+            TxOutput(ADDRS_C[0], 100),
+            TxOutput(ADDRS_C[1], 10, codecs.OutputType.DELEGATE_VOTING_POWER),
+        ],
+    )
+    assert ours2.fees(input_amount=100) == 0

@@ -1,0 +1,25 @@
+"""upow-tpu: a TPU-native framework with the capabilities of upowai/upow.
+
+A wire-compatible uPow blockchain node, miner, and wallet whose two hot
+kernels — sha256 nonce search and batched NIST P-256 ECDSA / UTXO block
+validation — run on TPU via JAX/XLA/Pallas, with a pure consensus core,
+backend-abstracted crypto (``device=cpu|tpu``), and a thin asyncio HTTP /
+sqlite shell that stays endpoint- and schema-compatible with the reference.
+
+Layering (bottom-up), mirroring SURVEY.md §1 but with the DB knot cut:
+
+- ``core``   — pure protocol kernel: codecs, tx/header wire formats,
+               difficulty, rewards, merkle.  No I/O, no DB, no JAX.
+- ``crypto`` — backend-abstracted primitives (sha256 batch, P-256 ECDSA),
+               CPU (hashlib/OpenSSL/C++) and TPU (Pallas/jnp) backends.
+- ``mine``   — TPU nonce search: midstate-split Pallas sha256 kernel,
+               sharded over a device mesh; host mining loop.
+- ``state``  — chain state store (sqlite, Postgres-schema-compatible) +
+               device-resident UTXO set.
+- ``verify`` — batched block validation pipeline (device) + DPoS rules
+               against an abstract state view (host).
+- ``node``   — asyncio HTTP shell, gossip, sync; ``ws`` — WebSocket push.
+- ``wallet`` — key management, tx builders, CLI.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,46 @@
+"""Pure protocol kernel: constants, codecs, wire formats, consensus math.
+
+Everything here is deterministic, I/O-free and byte-compatible with the
+reference implementation (citations are ``file:line`` into /root/reference).
+"""
+
+from .constants import (
+    ENDIAN,
+    SMALLEST,
+    MAX_SUPPLY,
+    VERSION,
+    MAX_BLOCK_SIZE_HEX,
+    MAX_INODES,
+    CURVE_P,
+    CURVE_N,
+)
+from .codecs import (
+    sha256_hex,
+    b58encode,
+    b58decode,
+    AddressFormat,
+    TransactionType,
+    OutputType,
+    InputType,
+    point_to_bytes,
+    bytes_to_point,
+    point_to_string,
+    string_to_point,
+    string_to_bytes,
+    bytes_to_string,
+    transaction_type_from_message,
+)
+from .tx import Tx, TxInput, TxOutput, CoinbaseTx, tx_from_hex
+from .header import BlockHeader, split_block_content, block_to_bytes
+from .difficulty import (
+    difficulty_to_hashrate,
+    hashrate_to_difficulty,
+    charset_count,
+    check_pow,
+    next_difficulty,
+    START_DIFFICULTY,
+    BLOCK_TIME,
+    BLOCKS_COUNT,
+)
+from .rewards import get_block_reward, get_inode_rewards, get_circulating_supply
+from .merkle import merkle_root, merkle_root_ordered

@@ -1,0 +1,210 @@
+"""Primitive codecs: sha256 (hex-aware), base58, address <-> point, enums.
+
+Byte-compatible with /root/reference/upow/helpers.py.  Clean-room
+implementations — no base58/fastecdsa dependency.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from enum import Enum, IntEnum
+from math import ceil
+from typing import Tuple, Union
+
+from .constants import CURVE_A, CURVE_B, CURVE_P, ENDIAN
+
+
+def sha256_hex(message: Union[str, bytes]) -> str:
+    """sha256 hexdigest; a str argument is interpreted as HEX, not text.
+
+    Matches helpers.py:41-44 — the whole chain hashes raw bytes, and every
+    hex string is decoded before hashing.
+    """
+    if isinstance(message, str):
+        message = bytes.fromhex(message)
+    return hashlib.sha256(message).hexdigest()
+
+
+def sha256_bytes(message: Union[str, bytes]) -> bytes:
+    if isinstance(message, str):
+        message = bytes.fromhex(message)
+    return hashlib.sha256(message).digest()
+
+
+def byte_length(i: int) -> int:
+    """Minimum bytes to hold ``i`` (helpers.py:47-48)."""
+    return ceil(i.bit_length() / 8.0)
+
+
+# --- base58 (Bitcoin alphabet) ------------------------------------------
+
+_B58_ALPHABET = "123456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz"
+_B58_INDEX = {c: i for i, c in enumerate(_B58_ALPHABET)}
+
+
+def b58encode(data: bytes) -> str:
+    n = int.from_bytes(data, "big")
+    out = []
+    while n:
+        n, r = divmod(n, 58)
+        out.append(_B58_ALPHABET[r])
+    pad = 0
+    for b in data:
+        if b == 0:
+            pad += 1
+        else:
+            break
+    return "1" * pad + "".join(reversed(out))
+
+
+def b58decode(s: str) -> bytes:
+    n = 0
+    for c in s:
+        try:
+            n = n * 58 + _B58_INDEX[c]
+        except KeyError:
+            raise ValueError(f"invalid base58 character {c!r}")
+    body = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    pad = 0
+    for c in s:
+        if c == "1":
+            pad += 1
+        else:
+            break
+    return b"\x00" * pad + body
+
+
+# --- enums (helpers.py:65-95) -------------------------------------------
+
+
+class AddressFormat(Enum):
+    FULL_HEX = "hex"
+    COMPRESSED = "compressed"
+
+
+class TransactionType(IntEnum):
+    REGULAR = 0
+    INODE_DE_REGISTRATION = 4
+    VALIDATOR_REGISTRATION = 5
+    VOTE_AS_VALIDATOR = 6
+    VOTE_AS_DELEGATE = 7
+    REVOKE_AS_VALIDATOR = 8
+    REVOKE_AS_DELEGATE = 9
+
+
+class OutputType(IntEnum):
+    REGULAR = 0
+    STAKE = 1
+    UN_STAKE = 2
+    INODE_REGISTRATION = 3
+    VALIDATOR_REGISTRATION = 5
+    VOTE_AS_VALIDATOR = 6
+    VOTE_AS_DELEGATE = 7
+    VALIDATOR_VOTING_POWER = 8
+    DELEGATE_VOTING_POWER = 9
+
+
+class InputType(IntEnum):
+    REGULAR = 0
+    FEES = 10
+
+
+def transaction_type_from_message(message: bytes | None) -> TransactionType:
+    """Tx type is encoded in the free-form message bytes (helpers.py:97-112).
+
+    The message decodes (utf-8, falling back to its hex form) to the decimal
+    value of a TransactionType; anything unparseable is REGULAR.
+    """
+    if message is None:
+        return TransactionType.REGULAR
+    try:
+        try:
+            text = message.decode("utf-8")
+        except UnicodeDecodeError:
+            text = message.hex()
+        value = int(text)
+        return TransactionType(value) if value in TransactionType._value2member_map_ else TransactionType.REGULAR
+    except (ValueError, TypeError):
+        return TransactionType.REGULAR
+
+
+# --- curve point <-> address codecs (helpers.py:58-62, 126-192) ----------
+#
+# Addresses come in two formats:
+#   FULL_HEX   — 64 bytes: x||y, each 32-byte little-endian, hex-encoded.
+#   COMPRESSED — 33 bytes: 0x2A (y even) or 0x2B (y odd) || x 32-byte LE,
+#                base58-encoded.
+# A "point" here is a plain (x, y) int tuple on P-256.
+
+Point = Tuple[int, int]
+
+
+def is_on_curve(point: Point) -> bool:
+    x, y = point
+    return (y * y - (x * x * x + CURVE_A * x + CURVE_B)) % CURVE_P == 0
+
+
+def x_to_y(x: int, is_odd: bool = False) -> int:
+    """Decompress: recover y from x and its parity (helpers.py:58-62).
+
+    p ≡ 3 (mod 4) so sqrt is a single exponentiation.
+    """
+    y2 = (x * x * x + CURVE_A * x + CURVE_B) % CURVE_P
+    y = pow(y2, (CURVE_P + 1) // 4, CURVE_P)
+    if y * y % CURVE_P != y2:
+        raise ValueError("x is not on the curve")
+    return y if y % 2 == is_odd else CURVE_P - y
+
+
+def point_to_bytes(point: Point, address_format: AddressFormat = AddressFormat.FULL_HEX) -> bytes:
+    x, y = point
+    if address_format is AddressFormat.FULL_HEX:
+        return x.to_bytes(32, ENDIAN) + y.to_bytes(32, ENDIAN)
+    elif address_format is AddressFormat.COMPRESSED:
+        return (42 if y % 2 == 0 else 43).to_bytes(1, ENDIAN) + x.to_bytes(32, ENDIAN)
+    raise NotImplementedError()
+
+
+def bytes_to_point(point_bytes: bytes) -> Point:
+    if len(point_bytes) == 64:
+        x = int.from_bytes(point_bytes[:32], ENDIAN)
+        y = int.from_bytes(point_bytes[32:], ENDIAN)
+        # The reference's fastecdsa Point constructor validates on-curve
+        # and raises; decode-acceptance must match (consensus surface).
+        if not is_on_curve((x, y)):
+            raise ValueError("64-byte address is not a point on P-256")
+        return (x, y)
+    elif len(point_bytes) == 33:
+        specifier = point_bytes[0]
+        x = int.from_bytes(point_bytes[1:], ENDIAN)
+        return (x, x_to_y(x, specifier == 43))
+    raise NotImplementedError()
+
+
+def point_to_string(point: Point, address_format: AddressFormat = AddressFormat.COMPRESSED) -> str:
+    if address_format is AddressFormat.FULL_HEX:
+        return point_to_bytes(point).hex()
+    elif address_format is AddressFormat.COMPRESSED:
+        return b58encode(point_to_bytes(point, AddressFormat.COMPRESSED))
+    raise NotImplementedError()
+
+
+def string_to_bytes(string: str) -> bytes:
+    """Address string to bytes: hex first, base58 fallback (helpers.py:183-188)."""
+    try:
+        return bytes.fromhex(string)
+    except ValueError:
+        return b58decode(string)
+
+
+def bytes_to_string(point_bytes: bytes) -> str:
+    point = bytes_to_point(point_bytes)
+    if len(point_bytes) == 64:
+        return point_to_string(point, AddressFormat.FULL_HEX)
+    elif len(point_bytes) == 33:
+        return point_to_string(point, AddressFormat.COMPRESSED)
+    raise NotImplementedError()
+
+
+def string_to_point(string: str) -> Point:
+    return bytes_to_point(string_to_bytes(string))

@@ -1,0 +1,29 @@
+"""Protocol constants, byte-compatible with the reference.
+
+Reference: /root/reference/upow/constants.py:1-9.  The signature curve is
+NIST P-256 (``constants.py:4`` — ``CURVE = curve.P256``); its domain
+parameters are spelled out here so the framework has no external ECC
+dependency.
+"""
+
+# All integer serialization is little-endian (constants.py:3).
+ENDIAN = "little"
+
+# 8 decimal places: amounts are integers in "smallest" units on the wire
+# (constants.py:5).  The framework keeps amounts as int smallest-units
+# everywhere except the Decimal-sensitive inode reward split.
+SMALLEST = 100_000_000
+
+MAX_SUPPLY = 18_884_643.75  # constants.py:6
+VERSION = 2  # tx version (constants.py:7)
+MAX_BLOCK_SIZE_HEX = 4096 * 1024  # 4 MB hex == 2 MB raw (constants.py:8)
+MAX_INODES = 12  # constants.py:9
+
+# --- NIST P-256 (secp256r1) domain parameters ---------------------------
+# y^2 = x^3 + ax + b over GF(p);  base point G of prime order n.
+CURVE_P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+CURVE_A = CURVE_P - 3
+CURVE_B = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
+CURVE_N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+CURVE_GX = 0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296
+CURVE_GY = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5

@@ -1,0 +1,131 @@
+"""Clean-room NIST P-256 ECDSA: keygen, RFC6979 sign, verify.
+
+This is the host-side reference implementation (the role fastecdsa's C
+extension plays in the reference — upow/transaction_input.py:84-86,100-109).
+The batched TPU verify kernel in ``upow_tpu.crypto`` is differential-tested
+against it; the fast CPU path uses OpenSSL via ``cryptography`` when
+available.
+
+Signatures are (r, s) int pairs over sha256 of the message bytes, matching
+``fastecdsa.ecdsa.sign(msg, d)`` / ``verify`` defaults.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets
+from typing import Optional, Tuple
+
+from .constants import CURVE_A, CURVE_GX, CURVE_GY, CURVE_N, CURVE_P
+
+Point = Optional[Tuple[int, int]]  # None is the point at infinity
+G: Point = (CURVE_GX, CURVE_GY)
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, -1, m)
+
+
+def point_add(p1: Point, p2: Point) -> Point:
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % CURVE_P == 0:
+            return None
+        lam = (3 * x1 * x1 + CURVE_A) * _inv(2 * y1, CURVE_P) % CURVE_P
+    else:
+        lam = (y2 - y1) * _inv(x2 - x1, CURVE_P) % CURVE_P
+    x3 = (lam * lam - x1 - x2) % CURVE_P
+    y3 = (lam * (x1 - x3) - y1) % CURVE_P
+    return (x3, y3)
+
+
+def point_mul(k: int, p: Point) -> Point:
+    result: Point = None
+    addend = p
+    while k:
+        if k & 1:
+            result = point_add(result, addend)
+        addend = point_add(addend, addend)
+        k >>= 1
+    return result
+
+
+def keygen(rng: Optional[int] = None) -> Tuple[int, Tuple[int, int]]:
+    """Return (private_key, public_point)."""
+    d = (rng if rng is not None else secrets.randbelow(CURVE_N - 1)) % CURVE_N
+    if d == 0:
+        d = 1
+    pub = point_mul(d, G)
+    assert pub is not None
+    return d, pub
+
+
+def _bits2int(b: bytes) -> int:
+    i = int.from_bytes(b, "big")
+    blen = len(b) * 8
+    qlen = CURVE_N.bit_length()
+    if blen > qlen:
+        i >>= blen - qlen
+    return i
+
+
+def _rfc6979_k(msg_hash: bytes, d: int) -> int:
+    """Deterministic nonce per RFC 6979 with HMAC-SHA256."""
+    qlen_bytes = (CURVE_N.bit_length() + 7) // 8
+    h1 = _bits2int(msg_hash) % CURVE_N
+    x_octets = d.to_bytes(qlen_bytes, "big")
+    h1_octets = h1.to_bytes(qlen_bytes, "big")
+    v = b"\x01" * 32
+    k = b"\x00" * 32
+    k = hmac.new(k, v + b"\x00" + x_octets + h1_octets, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    k = hmac.new(k, v + b"\x01" + x_octets + h1_octets, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        t = b""
+        while len(t) < qlen_bytes:
+            v = hmac.new(k, v, hashlib.sha256).digest()
+            t += v
+        nonce = _bits2int(t[:qlen_bytes])
+        if 0 < nonce < CURVE_N:
+            return nonce
+        k = hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(k, v, hashlib.sha256).digest()
+
+
+def sign(message: bytes, d: int) -> Tuple[int, int]:
+    """ECDSA sign sha256(message) with deterministic RFC6979 nonce."""
+    msg_hash = hashlib.sha256(message).digest()
+    z = _bits2int(msg_hash)
+    while True:
+        k = _rfc6979_k(msg_hash, d)
+        p = point_mul(k, G)
+        assert p is not None
+        r = p[0] % CURVE_N
+        if r == 0:
+            continue
+        s = _inv(k, CURVE_N) * (z + r * d) % CURVE_N
+        if s == 0:
+            continue
+        return (r, s)
+
+
+def verify(signature: Tuple[int, int], message: bytes, pub: Tuple[int, int]) -> bool:
+    """ECDSA verify (r, s) over sha256(message) against public point."""
+    r, s = signature
+    if not (0 < r < CURVE_N and 0 < s < CURVE_N):
+        return False
+    z = _bits2int(hashlib.sha256(message).digest())
+    w = _inv(s, CURVE_N)
+    u1 = z * w % CURVE_N
+    u2 = r * w % CURVE_N
+    p = point_add(point_mul(u1, G), point_mul(u2, pub))
+    if p is None:
+        return False
+    return p[0] % CURVE_N == r % CURVE_N

@@ -1,0 +1,124 @@
+"""Difficulty math and PoW validity — byte-exact with manager.py:26-151.
+
+uPow's PoW rule: sha256(header) must *start with* the last
+``int(difficulty)`` hex chars of the previous block's hash, and for a
+fractional difficulty the next hex char must fall in a restricted charset
+prefix of size ``ceil(16 * (1 - frac))``.
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal
+from math import ceil, floor, log
+from typing import Optional, Tuple
+
+from .codecs import sha256_hex
+
+BLOCK_TIME = 60  # seconds (manager.py:26)
+BLOCKS_COUNT = Decimal(100)  # retarget window (manager.py:27)
+START_DIFFICULTY = Decimal("6.0")  # manager.py:29
+LAST_BLOCK_FOR_GENESIS_KEY = 10000  # manager.py:28
+
+HEX_CHARSET = "0123456789abcdef"
+
+
+def difficulty_to_hashrate_old(difficulty: Decimal) -> Decimal:
+    decimal = difficulty % 1 or 1 / 16
+    return Decimal(16 ** int(difficulty) * (16 * decimal))
+
+
+def difficulty_to_hashrate(difficulty: Decimal) -> Decimal:
+    """Expected hashes per block at a difficulty (manager.py:44-46)."""
+    decimal = difficulty % 1
+    return Decimal(16 ** int(difficulty) * (16 / ceil(16 * (1 - decimal))))
+
+
+def hashrate_to_difficulty_old(hashrate) -> Decimal:
+    difficulty = int(log(hashrate, 16))
+    if hashrate == 16 ** difficulty:
+        return Decimal(difficulty)
+    return Decimal(difficulty + (hashrate / Decimal(16) ** difficulty) / 16)
+
+
+def hashrate_to_difficulty(hashrate) -> Decimal:
+    """Inverse map with 0.1-step fractional search (manager.py:67-80)."""
+    difficulty = int(log(hashrate, 16))
+    ratio = hashrate / 16 ** difficulty
+
+    for i in range(0, 10):
+        coeff = 16 / ceil(16 * (1 - i / 10))
+        if coeff > ratio:
+            decimal = (i - 1) / Decimal(10)
+            return Decimal(difficulty + decimal)
+        if coeff == ratio:
+            decimal = i / Decimal(10)
+            return Decimal(difficulty + decimal)
+
+    return Decimal(difficulty) + Decimal("0.9")
+
+
+def charset_count(difficulty) -> int:
+    """Allowed-charset size for the fractional hex char (manager.py:145-146)."""
+    decimal = Decimal(str(difficulty)) % 1
+    return ceil(16 * (1 - decimal)) if decimal > 0 else 16
+
+
+def pow_target(previous_hash: str, difficulty) -> Tuple[str, int, int]:
+    """(required_prefix, int_difficulty, charset_count) for a template.
+
+    The prefix is the last int(difficulty) hex chars of the previous hash
+    (miner.py:43-56, manager.py:142-151).  Consensus quirk replicated
+    exactly: at difficulty < 1 the reference's ``prev_hash[-0:]`` slice is
+    the WHOLE previous hash, making sub-1 difficulties effectively
+    unminable.
+    """
+    difficulty = Decimal(str(difficulty))
+    int_difficulty = int(floor(difficulty))
+    return previous_hash[-int_difficulty:], int_difficulty, charset_count(difficulty)
+
+
+def check_pow_hash(block_hash: str, previous_hash: str, difficulty) -> bool:
+    """Does an already-computed block hash satisfy the PoW rule?"""
+    prefix, int_difficulty, count = pow_target(previous_hash, difficulty)
+    if count < 16:
+        return block_hash.startswith(prefix) and block_hash[int_difficulty] in HEX_CHARSET[:count]
+    return block_hash.startswith(prefix)
+
+
+def check_pow(block_content: str, previous_hash: Optional[str], difficulty) -> bool:
+    """Full PoW validity check (manager.py:130-151).
+
+    ``previous_hash=None`` mirrors the genesis case where the last block has
+    no hash: anything is valid.
+    """
+    if previous_hash is None:
+        return True
+    return check_pow_hash(sha256_hex(block_content), previous_hash, difficulty)
+
+
+def next_difficulty(last_block: Optional[dict], window_start_timestamp: Optional[int]) -> Decimal:
+    """Retarget rule (manager.py:83-121), as a pure function.
+
+    ``last_block`` needs keys id/timestamp/difficulty; the caller supplies
+    the timestamp of block ``id - 99`` when ``id % 100 == 0`` (the only
+    case it is read).  Returns the difficulty for the *next* block.
+    """
+    if last_block is None:
+        return START_DIFFICULTY
+    if last_block["id"] < BLOCKS_COUNT:
+        return START_DIFFICULTY
+    if last_block["id"] % BLOCKS_COUNT != 0:
+        return Decimal(str(last_block["difficulty"]))
+
+    elapsed = last_block["timestamp"] - window_start_timestamp
+    average_per_block = elapsed / BLOCKS_COUNT
+    last_difficulty = Decimal(str(last_block["difficulty"]))
+    hashrate = difficulty_to_hashrate(last_difficulty)
+    ratio = BLOCK_TIME / average_per_block
+    if last_block["id"] >= 180_000:  # difficulty can at most double (manager.py:109-110)
+        ratio = min(ratio, 2)
+    hashrate *= ratio
+    new_difficulty = hashrate_to_difficulty(hashrate)
+    if new_difficulty < START_DIFFICULTY and last_block["id"] >= 590_600:
+        return START_DIFFICULTY  # floor after block 590600 (manager.py:114-116)
+    return new_difficulty

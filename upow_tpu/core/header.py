@@ -1,0 +1,116 @@
+"""Block header codec: 138-byte v1 / 108-byte v2 (manager.py:385-419).
+
+Layout (all integers little-endian):
+
+    [version(1) only if v2] | prev_hash(32) | address(64 v1 / 33 v2)
+    | merkle_root(32) | timestamp(4) | difficulty*10(2) | nonce(4)
+
+v1 is exactly 138 bytes and has no version byte; anything else starts with
+a version byte > 1 (v2 == 108 bytes).  The nonce is the final 4 bytes —
+the property the TPU midstate-split sha256 kernel exploits.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from decimal import Decimal
+from io import BytesIO
+from typing import Tuple
+
+from .codecs import bytes_to_string, string_to_bytes
+from .constants import ENDIAN
+
+HEADER_SIZE_V1 = 138
+HEADER_SIZE_V2 = 108
+NONCE_OFFSET_V1 = 134
+NONCE_OFFSET_V2 = 104
+
+
+@dataclass
+class BlockHeader:
+    previous_hash: str
+    address: str
+    merkle_root: str
+    timestamp: int
+    difficulty_x10: int  # difficulty * 10, as stored on the wire
+    nonce: int
+
+    @property
+    def difficulty(self) -> Decimal:
+        # Decimal, not float: 63/10 must compare equal to Decimal("6.3")
+        # the way the reference's split_block_content result does.
+        return self.difficulty_x10 / Decimal(10)
+
+    @property
+    def version(self) -> int:
+        return 1 if len(string_to_bytes(self.address)) == 64 else 2
+
+    def prefix_bytes(self) -> bytes:
+        """Everything up to (not including) the 4-byte nonce — the miner's
+        per-template constant (miner.py:74-82)."""
+        address_bytes = string_to_bytes(self.address)
+        version = b"" if len(address_bytes) == 64 else bytes([2])
+        return (
+            version
+            + bytes.fromhex(self.previous_hash)
+            + address_bytes
+            + bytes.fromhex(self.merkle_root)
+            + self.timestamp.to_bytes(4, ENDIAN)
+            + self.difficulty_x10.to_bytes(2, ENDIAN)
+        )
+
+    def tobytes(self) -> bytes:
+        return self.prefix_bytes() + self.nonce.to_bytes(4, ENDIAN)
+
+    def hex(self) -> str:
+        return self.tobytes().hex()
+
+
+def block_to_bytes(last_block_hash: str, block: dict) -> bytes:
+    """Reference-shaped dict -> header bytes (manager.py:385-398)."""
+    return BlockHeader(
+        previous_hash=last_block_hash,
+        address=block["address"],
+        merkle_root=block["merkle_tree"],
+        timestamp=int(block["timestamp"]),
+        difficulty_x10=int(float(block["difficulty"]) * 10),
+        nonce=block["random"],
+    ).tobytes()
+
+
+def split_block_content(block_content: str) -> Tuple[str, str, str, int, Decimal, int]:
+    """header hex -> (prev_hash, address, merkle, timestamp, difficulty, nonce)
+
+    Mirrors manager.py:401-419 including its strictness: v1 is length-138
+    exactly, v2 must be length-108, others unsupported.
+    """
+    header = parse_header(block_content)
+    return (
+        header.previous_hash,
+        header.address,
+        header.merkle_root,
+        header.timestamp,
+        header.difficulty,
+        header.nonce,
+    )
+
+
+def parse_header(block_content: str) -> BlockHeader:
+    raw = bytes.fromhex(block_content)
+    stream = BytesIO(raw)
+    if len(raw) == HEADER_SIZE_V1:
+        version = 1
+    else:
+        version = int.from_bytes(stream.read(1), ENDIAN)
+        assert version > 1, "not a v1 (138-byte) header and no version byte"
+        if version == 2:
+            assert len(raw) == HEADER_SIZE_V2, f"v2 header must be 108 bytes, got {len(raw)}"
+        else:
+            raise NotImplementedError(f"unknown header version {version}")
+    previous_hash = stream.read(32).hex()
+    address = bytes_to_string(stream.read(64 if version == 1 else 33))
+    merkle_root = stream.read(32).hex()
+    timestamp = int.from_bytes(stream.read(4), ENDIAN)
+    difficulty_x10 = int.from_bytes(stream.read(2), ENDIAN)
+    nonce = int.from_bytes(stream.read(4), ENDIAN)
+    return BlockHeader(previous_hash, address, merkle_root, timestamp, difficulty_x10, nonce)

@@ -1,0 +1,46 @@
+"""Transaction "merkle tree" — actually a flat hash (manager.py:352-378).
+
+root = sha256( concat( sha256(raw_tx) for raw_tx sorted by raw bytes ) )
+
+The ordered variant skips the sort (used by the miner over the hash list
+the node hands it, and historically for blocks < 22500).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, List, Union
+
+TxLike = Union[str, "object"]  # hex string or object with .hex()
+
+
+def _raw(tx: TxLike) -> bytes:
+    return bytes.fromhex(tx if isinstance(tx, str) else tx.hex())
+
+
+def merkle_root(transactions: Iterable[TxLike]) -> str:
+    """Sorted-by-raw-bytes flat hash (manager.py:365-378)."""
+    acc = b""
+    for raw in sorted(_raw(tx) for tx in transactions):
+        acc += hashlib.sha256(raw).digest()
+    return hashlib.sha256(acc).hexdigest()
+
+
+def merkle_root_ordered(transactions: Iterable[TxLike]) -> str:
+    """Order-preserving variant (manager.py:352-362)."""
+    acc = b""
+    for tx in transactions:
+        acc += hashlib.sha256(_raw(tx)).digest()
+    return hashlib.sha256(acc).hexdigest()
+
+
+def miner_merkle_root(tx_hashes: List[str]) -> str:
+    """The miner-side merkle over pending tx *hashes* (miner.py:15-18).
+
+    The node's get_mining_info hands the miner 64-char tx hashes
+    (node/main.py:630, and the reference miner asserts len == 64); joining
+    their raw digests and hashing equals the node's merkle_root only
+    because the node pre-sorts/pre-hashes — do NOT pass full tx hexes.
+    """
+    assert all(len(tx) == 64 for tx in tx_hashes), "expects 64-char tx hashes"
+    return hashlib.sha256(b"".join(bytes.fromhex(tx) for tx in tx_hashes)).hexdigest()

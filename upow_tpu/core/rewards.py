@@ -1,0 +1,123 @@
+"""Emission schedule and reward splitting — consensus-exact.
+
+The inode reward split is the one place the framework keeps Decimal
+arithmetic: the reference's behavior (9-digit precision context after block
+39000, quantization quirks, and redistribution folded into the per-address
+loop — manager.py:171-212) is consensus-critical, so it is replicated
+exactly, warts and all.  Everything else is int smallest-units.
+"""
+
+from __future__ import annotations
+
+import decimal
+from decimal import Decimal
+from typing import Dict, List, Tuple
+
+from .constants import MAX_SUPPLY, SMALLEST
+
+HALVING_INTERVAL = 1_576_800  # blocks ≈ 3 years of minutes (manager.py:156)
+NINE_HALVINGS = 14_191_200  # manager.py:158
+COINS_PER_BLOCK = 6
+DECIMAL_SWITCH_BLOCK = 39_000  # round_up behavior switch (manager.py:181-188)
+
+
+def round_up_decimal(value: Decimal, round_up_length: str = "0.00000001") -> Decimal:
+    """Quantize only when sub-smallest dust exists (helpers.py:147-151)."""
+    quantum = Decimal(round_up_length)
+    if (value * SMALLEST) % 1 != 0:
+        value = value.quantize(quantum)
+    return value
+
+
+def round_up_decimal_new(value: Decimal, round_up_length: str = "0.00000001") -> Decimal:
+    """Unconditional quantize (helpers.py:154-157), used after block 39000."""
+    return value.quantize(Decimal(round_up_length))
+
+
+def get_block_reward(block_no: int) -> int:
+    """Reward in smallest units: 6 coins halving every 1,576,800 blocks,
+    zero after 9 halvings (manager.py:154-168).
+
+    6e8 is divisible by 2^9 so the int math is exact at every halving.
+    """
+    assert block_no > 0
+    if block_no > NINE_HALVINGS:
+        return 0
+    num_halvings = block_no // HALVING_INTERVAL
+    if block_no % HALVING_INTERVAL == 0:
+        num_halvings -= 1
+    return (COINS_PER_BLOCK * SMALLEST) >> num_halvings
+
+
+def get_block_reward_decimal(block_no: int) -> Decimal:
+    return Decimal(get_block_reward(block_no)) / SMALLEST
+
+
+def get_inode_rewards(
+    reward: Decimal, inode_address_details: List[dict], block_no: int = 1
+) -> Tuple[Decimal, Dict[str, Decimal]]:
+    """Split the block reward 50/50 miner/inodes (manager.py:171-212).
+
+    Inodes receive pro-rata by emission percent; shares of inodes below 1%
+    are redistributed among those at >= 1%.  Faithful to the reference,
+    including the quirk that redistribution happens *inside* the loop (so
+    eligible wallets accrue a redistribution increment per iteration once
+    any sub-1% share has been seen) and the precision-9 local context after
+    block 39000.
+    """
+    total_percent = sum(entry["emission"] for entry in inode_address_details)
+    if not inode_address_details or total_percent <= 0:
+        return reward, {}
+    miner_reward = reward * Decimal(0.5)
+    distribution_reward = reward * Decimal(0.5)
+    distributed_rewards: Dict[str, Decimal] = {}
+    redistribution_reward = Decimal(0)
+
+    with decimal.localcontext() as ctx:
+        ctx.prec = 9 if block_no > DECIMAL_SWITCH_BLOCK else ctx.prec
+        for address_detail in inode_address_details:
+            percent = address_detail["emission"]
+            address_reward = distribution_reward * Decimal(percent) / Decimal(total_percent)
+            if block_no > DECIMAL_SWITCH_BLOCK:
+                address_reward = round_up_decimal_new(address_reward)
+            else:
+                address_reward = round_up_decimal(address_reward)
+            if percent >= 1:
+                distributed_rewards[address_detail["wallet"]] = address_reward
+            else:
+                redistribution_reward += (
+                    distribution_reward * Decimal(percent) / Decimal(total_percent)
+                )
+
+            if redistribution_reward > 0:
+                num_eligible = sum(1 for e in inode_address_details if e["emission"] >= 1)
+                redistribution_amount = redistribution_reward / num_eligible
+                if block_no > DECIMAL_SWITCH_BLOCK:
+                    redistribution_amount = round_up_decimal_new(redistribution_amount)
+                else:
+                    redistribution_amount = round_up_decimal(redistribution_amount)
+                for entry in inode_address_details:
+                    if entry["emission"] >= 1:
+                        distributed_rewards[entry["wallet"]] += redistribution_amount
+
+    return miner_reward, distributed_rewards
+
+
+def get_circulating_supply(block_no: int) -> Decimal:
+    """Supply after ``block_no`` blocks (manager.py:215-234)."""
+    halving_interval = 3 * 365 * 24 * 60
+    initial = COINS_PER_BLOCK
+    if block_no > halving_interval * 9:
+        return Decimal(MAX_SUPPLY)
+    supply = 0
+    num_halvings = block_no // halving_interval
+    remaining = block_no % halving_interval
+    if remaining == 0:
+        num_halvings -= 1
+    for i in range(num_halvings + 1):
+        current = initial / (2 ** i)
+        if i == num_halvings and remaining > 0:
+            supply += current * remaining
+        else:
+            supply += current * halving_interval
+    return supply

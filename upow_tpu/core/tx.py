@@ -1,0 +1,350 @@
+"""Transaction wire codec — byte-identical to the reference, but pure.
+
+Amounts are ints in smallest units (1e-8 coins) everywhere; the reference's
+Decimal amounts appear only at the JSON/API boundary.  The codec never
+touches a database: signature-to-input relinking for the ambiguous multi-sig
+case takes an optional address resolver callback instead of the reference's
+lazy ``Database`` imports (transaction.py:100,127 — the coupling SURVEY.md
+§1 says to cut).
+
+Wire layout (transaction.py:46-83):
+
+    version(1) | n_inputs(1) | inputs | n_outputs(1) | outputs
+    [ message_specifier | message ] [ signatures ] (full form only)
+
+    input  = tx_hash(32) | index(1) | input_type(1)            (34 B)
+    output = address(64 or 33) | amount_len(1) | amount(LE) | output_type(1)
+
+Version 1 carries 64-byte addresses, version 3 carries 33-byte compressed
+ones; message length is 1 byte for version <= 2 and 2 bytes LE for v3.
+Signatures are 64-byte r||s (32-byte LE each), deduplicated by value
+(transaction.py:76-82).  Coinbase txs use output-section specifier byte 36
+and version 2 for compressed addresses (coinbase_transaction.py:22-44).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from io import BytesIO
+from typing import Callable, List, Optional, Tuple, Union
+
+from .codecs import (
+    InputType,
+    OutputType,
+    TransactionType,
+    byte_length,
+    bytes_to_string,
+    is_on_curve,
+    sha256_hex,
+    string_to_bytes,
+    string_to_point,
+    transaction_type_from_message,
+)
+from .constants import ENDIAN, SMALLEST
+
+Signature = Tuple[int, int]
+
+
+@dataclass
+class TxInput:
+    """A reference to a spendable output (transaction_input.py:11-98)."""
+
+    tx_hash: str
+    index: int
+    input_type: InputType = InputType.REGULAR
+    signature: Optional[Signature] = None
+
+    def tobytes(self) -> bytes:
+        return (
+            bytes.fromhex(self.tx_hash)
+            + self.index.to_bytes(1, ENDIAN)
+            + int(self.input_type).to_bytes(1, ENDIAN)
+        )
+
+    def signature_hex(self) -> str:
+        r, s = self.signature
+        return r.to_bytes(32, ENDIAN).hex() + s.to_bytes(32, ENDIAN).hex()
+
+    @property
+    def outpoint(self) -> Tuple[str, int]:
+        return (self.tx_hash, self.index)
+
+
+@dataclass
+class TxOutput:
+    """address + amount (int smallest units) + type (transaction_output.py:7-26)."""
+
+    address: str
+    amount: int
+    output_type: OutputType = OutputType.REGULAR
+
+    def __post_init__(self):
+        self.address_bytes = string_to_bytes(self.address)
+
+    def tobytes(self) -> bytes:
+        count = byte_length(self.amount)
+        return (
+            self.address_bytes
+            + count.to_bytes(1, ENDIAN)
+            + self.amount.to_bytes(count, ENDIAN)
+            + int(self.output_type).to_bytes(1, ENDIAN)
+        )
+
+    def verify(self) -> bool:
+        """amount > 0 and the address decodes to a point on P-256."""
+        try:
+            return self.amount > 0 and is_on_curve(string_to_point(self.address))
+        except (ValueError, NotImplementedError):
+            return False
+
+    @property
+    def is_stake(self) -> bool:
+        return self.output_type == OutputType.STAKE
+
+
+class Tx:
+    """A regular transaction (transaction.py:21-238, codec parts only)."""
+
+    def __init__(
+        self,
+        inputs: List[TxInput],
+        outputs: List[TxOutput],
+        message: Optional[bytes] = None,
+        version: Optional[int] = None,
+    ):
+        if len(inputs) >= 256:
+            raise ValueError(f"max 255 inputs, not {len(inputs)}")
+        if len(outputs) >= 256:
+            raise ValueError(f"max 255 outputs, not {len(outputs)}")
+        self.inputs = inputs
+        self.outputs = outputs
+        self.message = message
+        self.transaction_type = transaction_type_from_message(message)
+        if version is None:
+            if all(len(o.address_bytes) == 64 for o in outputs):
+                version = 1
+            elif all(len(o.address_bytes) == 33 for o in outputs):
+                version = 3
+            else:
+                raise NotImplementedError("mixed address formats")
+        if version > 3:
+            raise NotImplementedError()
+        self.version = version
+        self._hash: Optional[str] = None
+
+    @property
+    def is_coinbase(self) -> bool:
+        return False
+
+    def hex(self, full: bool = True) -> str:
+        """Serialize; ``full=False`` is the signing form (transaction.py:46-83)."""
+        out = [
+            self.version.to_bytes(1, ENDIAN).hex(),
+            len(self.inputs).to_bytes(1, ENDIAN).hex(),
+            "".join(i.tobytes().hex() for i in self.inputs),
+            len(self.outputs).to_bytes(1, ENDIAN).hex(),
+            "".join(o.tobytes().hex() for o in self.outputs),
+        ]
+        hexstring = "".join(out)
+
+        # v1/v2 sign over inputs+outputs only; v3 also signs the message.
+        if not full and (self.version <= 2 or self.message is None):
+            return hexstring
+
+        if self.message is not None:
+            if self.version <= 2:
+                hexstring += bytes([1, len(self.message)]).hex()
+            else:
+                hexstring += bytes([1]).hex()
+                hexstring += len(self.message).to_bytes(2, ENDIAN).hex()
+            hexstring += self.message.hex()
+            if not full:
+                return hexstring
+        else:
+            hexstring += (0).to_bytes(1, ENDIAN).hex()
+
+        # Signatures deduplicated by value: one per distinct (key, sig).
+        seen = []
+        for tx_input in self.inputs:
+            signed = tx_input.signature_hex()
+            if signed not in seen:
+                seen.append(signed)
+                hexstring += signed
+        return hexstring
+
+    def hash(self) -> str:
+        if self._hash is None:
+            self._hash = sha256_hex(self.hex())
+        return self._hash
+
+    def fees(self, input_amount: int) -> int:
+        """fee = inputs − outputs, excluding synthetic voting-power outputs
+        (transaction.py:499-518).  ``input_amount`` comes from the state view."""
+        if self.transaction_type != TransactionType.REGULAR:
+            return 0
+        output_amount = sum(
+            o.amount
+            for o in self.outputs
+            if o.output_type
+            not in (OutputType.VALIDATOR_VOTING_POWER, OutputType.DELEGATE_VOTING_POWER)
+        )
+        return input_amount - output_amount
+
+    def sign(self, private_keys: List[int], pubkey_of: Callable[[TxInput], Tuple[int, int]]) -> "Tx":
+        """Sign every input whose resolved pubkey matches one of the keys.
+
+        ``pubkey_of`` maps an input to the public point of the output it
+        spends (the reference resolves this through the Database;
+        transaction.py:484-497).
+        """
+        from . import curve
+
+        signing_bytes = bytes.fromhex(self.hex(False))
+        key_by_point = {curve.point_mul(d, curve.G): d for d in private_keys}
+        for tx_input in self.inputs:
+            pub = pubkey_of(tx_input)
+            d = key_by_point.get(pub)
+            if d is not None:
+                tx_input.signature = curve.sign(signing_bytes, d)
+        return self
+
+    def __eq__(self, other):
+        return isinstance(other, (Tx, CoinbaseTx)) and self.hex() == other.hex()
+
+
+class CoinbaseTx:
+    """The miner-reward transaction (coinbase_transaction.py:8-47).
+
+    input = (block_hash, 0); output-section specifier byte 36; version 2
+    (not 3) for compressed addresses.  Multi-output when inode rewards are
+    appended (manager.py:694-700).
+    """
+
+    def __init__(self, block_hash: str, address: str, amount: int):
+        self.block_hash = block_hash
+        self.address = address
+        self.amount = amount
+        self.outputs = [TxOutput(address, amount)]
+        self._hex: Optional[str] = None
+        self.transaction_type = TransactionType.REGULAR
+        self.message = None
+        self.inputs: List[TxInput] = []
+
+    @property
+    def is_coinbase(self) -> bool:
+        return True
+
+    def hex(self, full: bool = True) -> str:
+        if self._hex is not None:
+            return self._hex
+        hex_inputs = (
+            bytes.fromhex(self.block_hash) + (0).to_bytes(1, ENDIAN)
+        ).hex() + int(InputType.REGULAR).to_bytes(1, ENDIAN).hex()
+        hex_outputs = "".join(o.tobytes().hex() for o in self.outputs)
+        if all(len(o.address_bytes) == 64 for o in self.outputs):
+            version = 1
+        elif all(len(o.address_bytes) == 33 for o in self.outputs):
+            version = 2
+        else:
+            raise NotImplementedError()
+        self._hex = "".join(
+            [
+                version.to_bytes(1, ENDIAN).hex(),
+                (1).to_bytes(1, ENDIAN).hex(),
+                hex_inputs,
+                len(self.outputs).to_bytes(1, ENDIAN).hex(),
+                hex_outputs,
+                (36).to_bytes(1, ENDIAN).hex(),
+            ]
+        )
+        return self._hex
+
+    def hash(self) -> str:
+        return sha256_hex(self.hex())
+
+    def fees(self, input_amount: int = 0) -> int:
+        return 0
+
+
+AddressResolver = Callable[[str, int], Optional[str]]
+
+
+def tx_from_hex(
+    hexstring: str,
+    check_signatures: bool = True,
+    resolve_address: Optional[AddressResolver] = None,
+) -> Union[Tx, CoinbaseTx]:
+    """Decode the wire format (transaction.py:520-592).
+
+    When the signature count matches neither 1 nor the input count, the
+    reference groups inputs by their (database-resolved) spending address
+    and assigns the i-th signature to the i-th distinct address.  Callers
+    that have state pass ``resolve_address(tx_hash, index) -> address`` for
+    that case; with ``check_signatures=False`` the relinking is skipped.
+    """
+    stream = BytesIO(bytes.fromhex(hexstring))
+    version = int.from_bytes(stream.read(1), ENDIAN)
+    if version > 3:
+        raise NotImplementedError()
+
+    inputs_count = int.from_bytes(stream.read(1), ENDIAN)
+    inputs = []
+    for _ in range(inputs_count):
+        tx_hash = stream.read(32).hex()
+        index = int.from_bytes(stream.read(1), ENDIAN)
+        input_type = int.from_bytes(stream.read(1), ENDIAN)
+        inputs.append(TxInput(tx_hash, index, InputType(input_type)))
+
+    outputs_count = int.from_bytes(stream.read(1), ENDIAN)
+    outputs = []
+    for _ in range(outputs_count):
+        pubkey = stream.read(64 if version == 1 else 33)
+        amount_length = int.from_bytes(stream.read(1), ENDIAN)
+        amount = int.from_bytes(stream.read(amount_length), ENDIAN)
+        output_type = int.from_bytes(stream.read(1), ENDIAN)
+        outputs.append(TxOutput(bytes_to_string(pubkey), amount, OutputType(output_type)))
+
+    specifier = int.from_bytes(stream.read(1), ENDIAN)
+    if specifier == 36:
+        assert len(inputs) == 1
+        coinbase = CoinbaseTx(inputs[0].tx_hash, outputs[0].address, outputs[0].amount)
+        if len(outputs) > 1:
+            coinbase.outputs.extend(outputs[1:])
+        return coinbase
+
+    if specifier == 1:
+        message_length = int.from_bytes(stream.read(1 if version <= 2 else 2), ENDIAN)
+        message = stream.read(message_length)
+    else:
+        assert specifier == 0
+        message = None
+
+    signatures = []
+    while True:
+        r = int.from_bytes(stream.read(32), ENDIAN)
+        s = int.from_bytes(stream.read(32), ENDIAN)
+        if r == 0:
+            break
+        signatures.append((r, s))
+
+    if len(signatures) == 1:
+        for tx_input in inputs:
+            tx_input.signature = signatures[0]
+    elif len(inputs) == len(signatures):
+        for tx_input, signed in zip(inputs, signatures):
+            tx_input.signature = signed
+    elif check_signatures:
+        if resolve_address is None:
+            raise ValueError(
+                "ambiguous signature layout needs an address resolver "
+                f"({len(inputs)} inputs, {len(signatures)} signatures)"
+            )
+        index: dict = {}
+        for tx_input in inputs:
+            address = resolve_address(tx_input.tx_hash, tx_input.index)
+            index.setdefault(address, []).append(tx_input)
+        for i, signed in enumerate(signatures):
+            for tx_input in index[list(index.keys())[i]]:
+                tx_input.signature = signed
+
+    return Tx(inputs, outputs, message, version)
