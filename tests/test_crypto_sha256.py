@@ -1,0 +1,125 @@
+"""Differential tests: TPU sha256 search kernels vs hashlib + reference rule.
+
+Covers: pure-Python compression, midstate-split templates for both header
+versions (108-byte v2, 138-byte v1 — manager.py:385-398), hit detection at
+integer and fractional difficulty, Pallas kernel (interpret mode on CPU),
+and the bucketed batch hasher.
+"""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+from upow_tpu.core.difficulty import check_pow_hash
+from upow_tpu.crypto import (
+    SENTINEL,
+    make_template,
+    pow_search_jnp,
+    pow_search_pallas,
+    sha256_batch_jnp,
+    sha256_py,
+    target_spec,
+)
+
+rng = random.Random(1234)
+
+
+def _rand_bytes(n):
+    return bytes(rng.randrange(256) for _ in range(n))
+
+
+@pytest.mark.parametrize("size", [0, 1, 55, 56, 63, 64, 65, 104, 107, 108, 127, 138, 200, 1000])
+def test_sha256_py_matches_hashlib(size):
+    msg = _rand_bytes(size)
+    assert sha256_py(msg) == hashlib.sha256(msg).digest()
+
+
+@pytest.mark.parametrize("prefix_len", [104, 134])  # v2 / v1 header prefixes
+def test_template_digest_matches_hashlib(prefix_len):
+    """Find the nonce the kernel reports and recompute its hash on host."""
+    prefix = _rand_bytes(prefix_len)
+    template = make_template(prefix)
+    # difficulty 1: prev hash whose last char is the target prefix
+    prev_hash = _rand_bytes(32).hex()
+    spec = target_spec(prev_hash, 1)
+    hit = int(pow_search_jnp(template, spec, nonce_base=0, batch=4096))
+    brute = next(
+        (n for n in range(4096)
+         if check_pow_hash(hashlib.sha256(prefix + n.to_bytes(4, "little")).hexdigest(), prev_hash, 1)),
+        int(SENTINEL),
+    )
+    assert hit == brute
+
+
+@pytest.mark.parametrize("difficulty", ["1", "2", "1.3", "2.7", "1.5"])
+def test_search_jnp_matches_bruteforce(difficulty):
+    prefix = _rand_bytes(104)
+    template = make_template(prefix)
+    prev_hash = _rand_bytes(32).hex()
+    spec = target_spec(prev_hash, difficulty)
+    batch = 8192
+    hit = int(pow_search_jnp(template, spec, nonce_base=0, batch=batch))
+    brute = next(
+        (n for n in range(batch)
+         if check_pow_hash(hashlib.sha256(prefix + n.to_bytes(4, "little")).hexdigest(),
+                           prev_hash, difficulty)),
+        int(SENTINEL),
+    )
+    assert hit == brute
+
+
+def test_search_nonce_base_offset():
+    """Hits found in a window that does not start at zero."""
+    prefix = _rand_bytes(104)
+    template = make_template(prefix)
+    prev_hash = _rand_bytes(32).hex()
+    spec = target_spec(prev_hash, 1)
+    base = 1 << 20
+    hit = int(pow_search_jnp(template, spec, nonce_base=base, batch=4096))
+    assert hit >= base
+    digest = hashlib.sha256(prefix + hit.to_bytes(4, "little")).hexdigest()
+    assert check_pow_hash(digest, prev_hash, 1)
+
+
+def test_search_no_hit_returns_sentinel():
+    prefix = _rand_bytes(104)
+    template = make_template(prefix)
+    # difficulty 8 in a 1k window: astronomically unlikely
+    spec = target_spec(_rand_bytes(32).hex(), 8)
+    assert int(pow_search_jnp(template, spec, nonce_base=0, batch=1024)) == int(SENTINEL)
+
+
+@pytest.mark.parametrize("difficulty", ["1", "1.4"])
+def test_pallas_matches_jnp(difficulty):
+    prefix = _rand_bytes(104)
+    template = make_template(prefix)
+    prev_hash = _rand_bytes(32).hex()
+    spec = target_spec(prev_hash, difficulty)
+    batch = 8192
+    a = int(pow_search_jnp(template, spec, nonce_base=0, batch=batch))
+    b = int(pow_search_pallas(template, spec, nonce_base=0, batch=batch,
+                              tile_rows=8, interpret=True))
+    assert a == b
+
+
+def test_v1_header_nonce_split_across_words():
+    """138-byte v1 header: nonce bytes straddle w1/w2 of the tail block."""
+    prefix = _rand_bytes(134)
+    template = make_template(prefix)
+    widxs = sorted({w for w, _ in template.nonce_spec})
+    assert widxs == [1, 2]
+    prev_hash = _rand_bytes(32).hex()
+    spec = target_spec(prev_hash, 1)
+    hit = int(pow_search_jnp(template, spec, nonce_base=0, batch=4096))
+    if hit != int(SENTINEL):
+        digest = hashlib.sha256(prefix + hit.to_bytes(4, "little")).hexdigest()
+        assert check_pow_hash(digest, prev_hash, 1)
+
+
+def test_sha256_batch_jnp_mixed_lengths():
+    msgs = [_rand_bytes(n) for n in [0, 3, 55, 56, 64, 120, 250, 250, 300, 1000]]
+    got = sha256_batch_jnp(msgs)
+    for m, d in zip(msgs, got):
+        assert d == hashlib.sha256(m).digest()

@@ -1,0 +1,24 @@
+"""Backend-abstracted crypto primitives (SURVEY.md §2.2).
+
+The reference leans on three native deps — hashlib/OpenSSL sha256
+(miner.py:52,61,87), fastecdsa's C extension for P-256 ECDSA
+(transaction_input.py:84-109), and GMP underneath.  Here the hot paths are
+TPU kernels with CPU fallbacks:
+
+* sha256 PoW search — :mod:`.sha256` (jnp + Pallas midstate kernels)
+* batched P-256 ECDSA verify — :mod:`.p256` (limb Montgomery, jnp)
+* host sign/keygen — :mod:`upow_tpu.core.curve` (pure Python, RFC6979)
+* C++ CPU fast paths — :mod:`upow_tpu.native` (ctypes, built on demand)
+"""
+
+from .sha256 import (
+    SearchTemplate,
+    TargetSpec,
+    make_template,
+    target_spec,
+    pow_search_jnp,
+    pow_search_pallas,
+    sha256_batch_jnp,
+    sha256_py,
+    SENTINEL,
+)

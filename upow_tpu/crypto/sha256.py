@@ -1,0 +1,347 @@
+"""SHA-256 for TPU proof-of-work: midstate split + batched final-block search.
+
+The uPow header puts the 4-byte nonce at the very end (header.py), so a
+mining template factors as
+
+    sha256(header) = compress(tail_block(nonce), midstate(prefix_blocks))
+
+where ``midstate`` covers every complete 64-byte block of the prefix (host,
+once per template) and only ONE compression runs per nonce on device
+(reference hot loop: /root/reference/miner.py:83-98 does the full hash per
+nonce in Python).
+
+Three implementations share the same round logic:
+
+* :func:`pow_search_jnp` — pure jax.numpy, runs anywhere (CPU tests, and a
+  perfectly good XLA:TPU program in its own right).
+* :func:`pow_search_pallas` — Pallas TPU kernel, tiled over the nonce batch.
+* :func:`_compress_py` — pure-Python compression for host-side midstate.
+
+Hit detection runs on device: the PoW rule (manager.py:130-151) — digest
+must start with the last ``int(difficulty)`` hex chars of the previous
+hash, fractional part restricts the next nibble — compiles down to two
+masked u32 compares plus a nibble bound, precomputed by :func:`target_spec`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# --- constants -----------------------------------------------------------
+
+_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+        0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+        0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+        0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+        0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+        0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+_H0 = np.array(
+    [0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+     0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19],
+    dtype=np.uint32,
+)
+
+SENTINEL = np.uint32(0xFFFFFFFF)  # "no hit" marker; nonce space is capped below it
+
+
+# --- pure-Python compression (host midstate) -----------------------------
+
+def _rotr_py(x: int, n: int) -> int:
+    return ((x >> n) | (x << (32 - n))) & 0xFFFFFFFF
+
+
+def _compress_py(state: Sequence[int], block: bytes) -> Tuple[int, ...]:
+    """One SHA-256 compression on the host (64-byte block)."""
+    w = list(np.frombuffer(block, dtype=">u4").astype(np.uint64))
+    w = [int(x) for x in w]
+    for i in range(16, 64):
+        s0 = _rotr_py(w[i - 15], 7) ^ _rotr_py(w[i - 15], 18) ^ (w[i - 15] >> 3)
+        s1 = _rotr_py(w[i - 2], 17) ^ _rotr_py(w[i - 2], 19) ^ (w[i - 2] >> 10)
+        w.append((w[i - 16] + s0 + w[i - 7] + s1) & 0xFFFFFFFF)
+    a, b, c, d, e, f, g, h = state
+    for i in range(64):
+        s1 = _rotr_py(e, 6) ^ _rotr_py(e, 11) ^ _rotr_py(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = (h + s1 + ch + int(_K[i]) + w[i]) & 0xFFFFFFFF
+        s0 = _rotr_py(a, 2) ^ _rotr_py(a, 13) ^ _rotr_py(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = (s0 + maj) & 0xFFFFFFFF
+        a, b, c, d, e, f, g, h = (t1 + t2) & 0xFFFFFFFF, a, b, c, (d + t1) & 0xFFFFFFFF, e, f, g
+    return tuple((x + y) & 0xFFFFFFFF for x, y in zip(state, (a, b, c, d, e, f, g, h)))
+
+
+def sha256_py(message: bytes) -> bytes:
+    """Full pure-Python sha256 (test oracle for the compression)."""
+    state = tuple(int(x) for x in _H0)
+    padded = message + b"\x80" + b"\x00" * ((55 - len(message)) % 64) + (8 * len(message)).to_bytes(8, "big")
+    for off in range(0, len(padded), 64):
+        state = _compress_py(state, padded[off:off + 64])
+    return b"".join(s.to_bytes(4, "big") for s in state)
+
+
+# --- template preparation (host) -----------------------------------------
+
+class SearchTemplate(NamedTuple):
+    """Everything the device kernel needs for one mining template.
+
+    midstate      : (8,)  uint32 — state after the full prefix blocks
+    tail_words    : (16,) uint32 — final block with nonce bytes zeroed,
+                    padding + length already applied
+    nonce_spec    : 4×(word_index, left_shift) — where each little-endian
+                    nonce byte lands in the tail words (static per header
+                    version: v2 108-byte header → all four bytes in w10;
+                    v1 138-byte header → split across w1/w2)
+    """
+
+    midstate: np.ndarray
+    tail_words: np.ndarray
+    nonce_spec: Tuple[Tuple[int, int], ...]
+
+
+def make_template(prefix: bytes) -> SearchTemplate:
+    """Build a search template from the header prefix (header minus nonce).
+
+    ``prefix`` is ``BlockHeader.prefix_bytes()`` — 104 bytes for v2, 134
+    for v1 (header.py).  The full message is ``prefix + nonce(4, LE)``.
+    """
+    total_len = len(prefix) + 4
+    n_full = len(prefix) // 64
+    if total_len - n_full * 64 > 56:  # nonce/padding must fit one tail block
+        raise ValueError("tail would span two blocks — unsupported header size")
+    state = tuple(int(x) for x in _H0)
+    for i in range(n_full):
+        state = _compress_py(state, prefix[i * 64:(i + 1) * 64])
+
+    tail = bytearray(64)
+    rem = prefix[n_full * 64:]
+    tail[: len(rem)] = rem
+    nonce_off = len(rem)  # nonce occupies tail[nonce_off : nonce_off+4]
+    tail[nonce_off + 4] = 0x80
+    tail[56:64] = (8 * total_len).to_bytes(8, "big")
+
+    # little-endian nonce byte j = (nonce >> 8j) & 0xFF lands at tail byte
+    # nonce_off + j, i.e. word (nonce_off+j)//4, big-endian byte slot
+    # (nonce_off+j)%4 → left shift 8*(3 - slot).
+    nonce_spec = tuple(
+        ((nonce_off + j) // 4, 8 * (3 - (nonce_off + j) % 4)) for j in range(4)
+    )
+    tail_words = np.frombuffer(bytes(tail), dtype=">u4").astype(np.uint32)
+    return SearchTemplate(np.array(state, dtype=np.uint32), tail_words, nonce_spec)
+
+
+class TargetSpec(NamedTuple):
+    """PoW acceptance test compiled to u32 compares (manager.py:130-151).
+
+    hit ⇔ (h0 & mask0)==val0 ∧ (h1 & mask1)==val1 ∧ next-nibble < charset
+    (charset check skipped when charset == 16).
+    """
+
+    mask0: np.uint32
+    val0: np.uint32
+    mask1: np.uint32
+    val1: np.uint32
+    nibble_word: int      # which digest word holds the fractional nibble
+    nibble_shift: int     # right-shift to land it in the low 4 bits
+    charset: int          # allowed-charset size; 16 disables the check
+
+
+def target_spec(previous_hash: str, difficulty) -> TargetSpec:
+    from ..core.difficulty import pow_target
+
+    prefix, k, charset = pow_target(previous_hash, difficulty)
+    if k > 16:
+        raise ValueError(f"difficulty prefix of {k} hex chars exceeds 2 digest words")
+    p0, p1 = prefix[:8], prefix[8:]
+    mask0 = ((1 << 4 * len(p0)) - 1) << (32 - 4 * len(p0)) if p0 else 0
+    val0 = int(p0, 16) << (32 - 4 * len(p0)) if p0 else 0
+    mask1 = ((1 << 4 * len(p1)) - 1) << (32 - 4 * len(p1)) if p1 else 0
+    val1 = int(p1, 16) << (32 - 4 * len(p1)) if p1 else 0
+    return TargetSpec(
+        np.uint32(mask0), np.uint32(val0), np.uint32(mask1), np.uint32(val1),
+        nibble_word=k // 8, nibble_shift=28 - 4 * (k % 8), charset=charset,
+    )
+
+
+# --- shared jnp round logic ----------------------------------------------
+
+def _rotr(x, n: int):
+    return (x >> n) | (x << (32 - n))
+
+
+def _compress_tail(midstate, w):
+    """One compression over message words ``w`` (list of 16 u32 arrays),
+    starting from ``midstate`` (tuple of 8 u32 arrays/scalars).
+
+    Fully unrolled: 64 rounds + 48 schedule extensions, all elementwise on
+    whatever batch shape ``w``'s elements carry — VPU-friendly, no
+    data-dependent control flow, so XLA/Mosaic vectorise it flat.
+    """
+    w = list(w)
+    a, b, c, d, e, f, g, h = midstate
+    for i in range(64):
+        if i >= 16:
+            w15, w2 = w[i - 15], w[i - 2]
+            s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> 3)
+            s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> 10)
+            w.append(w[i - 16] + s0 + w[i - 7] + s1)
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + jnp.uint32(_K[i]) + w[i]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        a, b, c, d, e, f, g, h = t1 + t2, a, b, c, d + t1, e, f, g
+    return tuple(x + y for x, y in zip(midstate, (a, b, c, d, e, f, g, h)))
+
+
+def _build_w(tail_words, nonces, nonce_spec):
+    """Scatter little-endian nonce bytes into the 16 tail words."""
+    w = [jnp.broadcast_to(tail_words[i], nonces.shape) for i in range(16)]
+    for j, (widx, shift) in enumerate(nonce_spec):
+        byte = (nonces >> jnp.uint32(8 * j)) & jnp.uint32(0xFF)
+        w[widx] = w[widx] | (byte << jnp.uint32(shift))
+    return w
+
+
+def _hit_nonce(digest, nonces, mask0, val0, mask1, val1, spec: TargetSpec):
+    ok = (digest[0] & mask0) == val0
+    ok &= (digest[1] & mask1) == val1
+    if spec.charset < 16:
+        nib = (digest[spec.nibble_word] >> jnp.uint32(spec.nibble_shift)) & jnp.uint32(0xF)
+        ok &= nib < jnp.uint32(spec.charset)
+    return jnp.min(jnp.where(ok, nonces, jnp.uint32(SENTINEL)))
+
+
+@functools.partial(jax.jit, static_argnames=("batch", "nonce_spec", "spec"))
+def _pow_search_jnp(midstate, tail_words, nonce_base, batch: int,
+                    nonce_spec, spec: TargetSpec):
+    nonces = nonce_base + jnp.arange(batch, dtype=jnp.uint32)
+    state = tuple(midstate[i] for i in range(8))
+    w = _build_w(tail_words, nonces, nonce_spec)
+    digest = _compress_tail(state, w)
+    t = [jnp.uint32(x) for x in (spec.mask0, spec.val0, spec.mask1, spec.val1)]
+    return _hit_nonce(digest, nonces, *t, spec)
+
+
+def pow_search_jnp(template: SearchTemplate, spec: TargetSpec,
+                   nonce_base: int, batch: int):
+    """Search [nonce_base, nonce_base+batch) — returns min hit or SENTINEL."""
+    return _pow_search_jnp(
+        jnp.asarray(template.midstate), jnp.asarray(template.tail_words),
+        jnp.uint32(nonce_base), batch, template.nonce_spec, spec,
+    )
+
+
+# --- Pallas TPU kernel ----------------------------------------------------
+
+def _pallas_kernel(mid_ref, tail_ref, base_ref, out_ref, *, tile_rows: int,
+                   nonce_spec, spec: TargetSpec):
+    from jax.experimental import pallas as pl  # local: keep module importable sans pallas
+
+    i = pl.program_id(0)
+    tile = tile_rows * 128
+    # nonce = base + program_id*tile + lane-linear index, as (tile_rows, 128)
+    lin = (jax.lax.broadcasted_iota(jnp.uint32, (tile_rows, 128), 0) * jnp.uint32(128)
+           + jax.lax.broadcasted_iota(jnp.uint32, (tile_rows, 128), 1))
+    nonces = base_ref[0] + jnp.uint32(i) * jnp.uint32(tile) + lin
+    state = tuple(mid_ref[j] for j in range(8))
+    w = [jnp.full((tile_rows, 128), tail_ref[j], dtype=jnp.uint32) for j in range(16)]
+    for j, (widx, shift) in enumerate(nonce_spec):
+        byte = (nonces >> jnp.uint32(8 * j)) & jnp.uint32(0xFF)
+        w[widx] = w[widx] | (byte << jnp.uint32(shift))
+    digest = _compress_tail(state, w)
+    t = [jnp.uint32(x) for x in (spec.mask0, spec.val0, spec.mask1, spec.val1)]
+    out_ref[0, 0] = _hit_nonce(digest, nonces, *t, spec)
+
+
+@functools.partial(jax.jit, static_argnames=("batch", "tile_rows", "nonce_spec", "spec", "interpret"))
+def _pow_search_pallas(midstate, tail_words, nonce_base, batch: int,
+                       tile_rows: int, nonce_spec, spec: TargetSpec,
+                       interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    tile = tile_rows * 128
+    assert batch % tile == 0, (batch, tile)
+    grid = batch // tile
+    kernel = functools.partial(
+        _pallas_kernel, tile_rows=tile_rows, nonce_spec=nonce_spec, spec=spec
+    )
+    per_tile = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((grid, 1), jnp.uint32),
+        interpret=interpret,
+    )(midstate, tail_words, nonce_base.reshape(1))
+    return jnp.min(per_tile)
+
+
+def pow_search_pallas(template: SearchTemplate, spec: TargetSpec,
+                      nonce_base: int, batch: int, tile_rows: int = 32,
+                      interpret: bool = False):
+    """Pallas-tiled search; same contract as :func:`pow_search_jnp`."""
+    return _pow_search_pallas(
+        jnp.asarray(template.midstate), jnp.asarray(template.tail_words),
+        jnp.uint32(nonce_base).reshape(()), batch, tile_rows,
+        template.nonce_spec, spec, interpret,
+    )
+
+
+# --- batched fixed-length digests (txids, tests) --------------------------
+
+@functools.partial(jax.jit, static_argnames=("n_blocks",))
+def _sha256_blocks_jnp(words, n_blocks: int):
+    """words: (batch, n_blocks*16) u32 big-endian message words, already
+    padded.  Returns (batch, 8) u32 digests."""
+    state = tuple(jnp.broadcast_to(jnp.uint32(h), words.shape[:1]) for h in _H0)
+    for b in range(n_blocks):
+        w = [words[:, b * 16 + i] for i in range(16)]
+        state = _compress_tail(state, w)
+    return jnp.stack(state, axis=1)
+
+
+def sha256_batch_jnp(messages: Sequence[bytes]) -> list:
+    """Batched sha256 of equal-or-bucketed-length messages on device.
+
+    Messages are bucketed by padded block count; each bucket is one jit'd
+    call.  Used for on-device txid batches (manager.py:365-378 hashes every
+    tx); odd stragglers cost one extra bucket, not a recompile per length.
+    """
+    out: list = [None] * len(messages)
+    buckets: dict = {}
+    for idx, m in enumerate(messages):
+        n_blocks = (len(m) + 8) // 64 + 1
+        buckets.setdefault(n_blocks, []).append(idx)
+    for n_blocks, idxs in buckets.items():
+        rows = np.zeros((len(idxs), n_blocks * 16), dtype=np.uint32)
+        for r, idx in enumerate(idxs):
+            m = messages[idx]
+            padded = (m + b"\x80" + b"\x00" * ((55 - len(m)) % 64)
+                      + (8 * len(m)).to_bytes(8, "big"))
+            rows[r] = np.frombuffer(padded, dtype=">u4").astype(np.uint32)
+        digests = np.asarray(_sha256_blocks_jnp(jnp.asarray(rows), n_blocks))
+        for r, idx in enumerate(idxs):
+            out[idx] = b"".join(int(x).to_bytes(4, "big") for x in digests[r])
+    return out
