@@ -1,0 +1,105 @@
+"""Headline benchmark: sha256 PoW search throughput on the real chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "MH/s", "vs_baseline": N}
+
+The baseline is the reference miner's hot loop — a pure-Python
+hashlib-per-nonce stride (reference miner.py:83-98) — measured live on
+this host's CPU for a short window, single worker (the reference's unit
+of scaling is one process per core; BASELINE.md pegs it at order
+0.1–1 Mh/s per core).  ``vs_baseline`` is our device rate over that.
+
+Run directly (``python bench.py``) on the TPU host; options:
+    --backend pallas|jnp|native|python   (default pallas on TPU, else jnp)
+    --seconds N      measurement window after warmup (default 10)
+    --batch N        nonces per device dispatch (default 2^24)
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _baseline_python_mhs(prefix: bytes, seconds: float = 1.0) -> float:
+    """Reference-shaped loop: one hashlib sha256 per nonce, difficulty
+    prefix check elided (it costs nothing vs the hash)."""
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < seconds:
+        for _ in range(2000):
+            hashlib.sha256(prefix + n.to_bytes(4, "little")).hexdigest()
+            n += 1
+    return n / (time.perf_counter() - t0) / 1e6
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default=None)
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--batch", type=int, default=1 << 24)
+    args = ap.parse_args()
+
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:
+        pass
+
+    platform = jax.devices()[0].platform
+    backend = args.backend or ("pallas" if platform not in ("cpu",) else "jnp")
+
+    from upow_tpu.core import curve, point_to_string
+    from upow_tpu.core.header import BlockHeader
+    from upow_tpu.core.merkle import merkle_root
+    from upow_tpu.crypto import SENTINEL, make_template, target_spec
+    from upow_tpu.crypto import sha256 as sk
+
+    _, pub = curve.keygen(rng=0xBE7C)
+    header = BlockHeader(
+        previous_hash=bytes(range(32)).hex(),
+        address=point_to_string(pub),
+        merkle_root=merkle_root([]),
+        timestamp=1_753_791_000,
+        difficulty_x10=90,  # difficulty 9: no realistic hit, pure throughput
+        nonce=0,
+    )
+    template = make_template(header.prefix_bytes())
+    spec = target_spec(header.previous_hash, "9.0")
+
+    search = (sk.pow_search_pallas if backend == "pallas" else sk.pow_search_jnp)
+
+    # warmup/compile
+    r = search(template, spec, nonce_base=0, batch=args.batch)
+    _ = int(r)
+
+    t0 = time.perf_counter()
+    hashes = 0
+    base = 0
+    while time.perf_counter() - t0 < args.seconds:
+        hit = search(template, spec, nonce_base=base, batch=args.batch)
+        _ = int(hit)  # block on the device round
+        hashes += args.batch
+        base = (base + args.batch) % (1 << 32)
+    mhs = hashes / (time.perf_counter() - t0) / 1e6
+
+    baseline = _baseline_python_mhs(header.prefix_bytes())
+    print(json.dumps({
+        "metric": f"sha256_pow_search_{backend}_{platform}",
+        "value": round(mhs, 3),
+        "unit": "MH/s",
+        "vs_baseline": round(mhs / baseline, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,20 +1,40 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Real TPU hardware in this environment is a single tunneled chip; all
-sharding/mesh tests run against 8 virtual CPU devices instead
-(xla_force_host_platform_device_count), and Pallas kernels run in
-interpret mode on CPU (handled inside upow_tpu.crypto via backend checks).
+Real TPU hardware in this environment is ONE tunneled chip claimed
+exclusively per process (the axon PJRT plugin registers in
+sitecustomize.py and force-sets ``jax_platforms="axon,cpu"``, overriding
+the JAX_PLATFORMS env var).  Running unit tests against it would
+serialize every test process behind a device claim — and a second
+concurrent pytest would block forever.  So tests pin JAX to plain CPU
+*via jax.config* (the only override that beats the plugin's
+config.update) with 8 virtual devices for sharding/mesh coverage;
+Pallas kernels run in interpret mode on CPU.
+
+The real chip is exercised by bench.py and the driver's compile checks,
+never by the unit suite.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (import after env setup)
+
+jax.config.update("jax_platforms", "cpu")
+# Persistent compilation cache: the P-256 verify ladder is a large program
+# whose XLA:CPU compile dominates suite time; cache it across runs.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 
 sys.path.insert(0, os.path.dirname(__file__))
 sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))  # repo root, for bare `pytest`

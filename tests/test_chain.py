@@ -1,0 +1,258 @@
+"""End-to-end chain tests: mine → accept → spend → stake → reorg → replay.
+
+Runs a real chain against an in-memory ChainState with difficulty patched
+to 1.0 (the protocol's pre-block-100 difficulty is 6.0 — 16M hashes —
+which is the miners' problem, not the test suite's).  Oracles per
+SURVEY.md §4: UTXO fingerprint equality and full-chain replay.
+"""
+
+import asyncio
+from decimal import Decimal
+
+import pytest
+
+from upow_tpu.core import curve, point_to_string
+from upow_tpu.core.clock import timestamp
+from upow_tpu.core.codecs import OutputType
+from upow_tpu.core.constants import SMALLEST
+from upow_tpu.core.header import BlockHeader
+from upow_tpu.core.merkle import merkle_root
+from upow_tpu.core.tx import Tx, TxInput, TxOutput
+from upow_tpu.mine.engine import MiningJob, mine
+from upow_tpu.state import ChainState
+from upow_tpu.verify import BlockManager
+from upow_tpu.verify.txverify import TxVerifier
+
+GENESIS_PREV = (18_884_643).to_bytes(32, "little").hex()
+
+
+@pytest.fixture(autouse=True)
+def easy_difficulty(monkeypatch):
+    from upow_tpu.core import difficulty
+
+    monkeypatch.setattr(difficulty, "START_DIFFICULTY", Decimal("1.0"))
+
+
+@pytest.fixture
+def keys():
+    d1, pub1 = curve.keygen(rng=111)
+    d2, pub2 = curve.keygen(rng=222)
+    return {
+        "d1": d1, "a1": point_to_string(pub1), "pub1": pub1,
+        "d2": d2, "a2": point_to_string(pub2), "pub2": pub2,
+    }
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def mine_and_accept(manager: BlockManager, state: ChainState, address: str,
+                          txs=(), ts_offset: int = 0) -> str:
+    """Build, mine (difficulty from the manager), and accept one block."""
+    difficulty, last_block = await manager.calculate_difficulty()
+    prev_hash = last_block["hash"] if last_block else GENESIS_PREV
+    header = BlockHeader(
+        previous_hash=prev_hash,
+        address=address,
+        merkle_root=merkle_root(list(txs)),
+        timestamp=timestamp() + ts_offset,
+        difficulty_x10=int(difficulty * 10),
+        nonce=0,
+    )
+    job = MiningJob(header.prefix_bytes(), prev_hash, difficulty)
+    if last_block:  # genesis PoW is free (check_pow with no previous hash)
+        result = mine(job, "python", batch=1 << 14, ttl=300)
+        assert result.nonce is not None
+        header.nonce = result.nonce
+    content = header.hex()
+    errors = []
+    ok = await manager.create_block(content, list(txs), errors=errors)
+    assert ok, errors
+    import hashlib
+
+    return hashlib.sha256(bytes.fromhex(content)).hexdigest()
+
+
+def make_send(state, sender_d, sender_addr, to_addr, amount, message=None):
+    async def _build():
+        spendable = await state.get_spendable_outputs(sender_addr)
+        total, chosen = 0, []
+        for i in spendable:
+            chosen.append(i)
+            total += i.amount
+            if total >= amount:
+                break
+        assert total >= amount, "insufficient funds"
+        outputs = [TxOutput(to_addr, amount)]
+        if total > amount:
+            outputs.append(TxOutput(sender_addr, total - amount))
+        tx = Tx(chosen, outputs, message=message)
+
+        async def pubkey_of(i):
+            from upow_tpu.core.codecs import string_to_point
+
+            addr = await state.resolve_output_address(i.tx_hash, i.index)
+            return string_to_point(addr)
+
+        pubs = {i.outpoint: await pubkey_of(i) for i in tx.inputs}
+        tx.sign([sender_d], lambda i: pubs[i.outpoint])
+        return tx
+
+    return _build()
+
+
+def test_genesis_then_spend_then_reorg(keys):
+    async def scenario():
+        state = ChainState()
+        manager = BlockManager(state, sig_backend="host")
+
+        # block 1: genesis, free PoW, coinbase pays a1
+        h1 = await mine_and_accept(manager, state, keys["a1"], ts_offset=-3)
+        assert await state.get_next_block_id() == 2
+        balance = await state.get_address_balance(keys["a1"])
+        assert balance == 6 * SMALLEST  # full reward, no inodes yet
+
+        # a1 sends 2 coins to a2 in block 2
+        tx = await make_send(state, keys["d1"], keys["a1"], keys["a2"], 2 * SMALLEST)
+        verifier = TxVerifier(state)
+        assert await verifier.verify(tx, sig_backend="host")
+        h2 = await mine_and_accept(
+            manager, state, keys["a1"], txs=[tx], ts_offset=-1)
+
+        assert await state.get_address_balance(keys["a2"]) == 2 * SMALLEST
+        # a1: 6 - 2 + change + new coinbase 6
+        assert await state.get_address_balance(keys["a1"]) == 10 * SMALLEST
+
+        # replay oracle: rebuilt UTXO set fingerprint matches the live one
+        live = await state.get_unspent_outputs_hash()
+        await state.rebuild_utxos()
+        assert await state.get_unspent_outputs_hash() == live
+
+        # reorg: drop block 2; a2's coins vanish, a1's spent output returns
+        await state.remove_blocks(2)
+        assert await state.get_next_block_id() == 2
+        assert await state.get_address_balance(keys["a2"]) == 0
+        assert await state.get_address_balance(keys["a1"]) == 6 * SMALLEST
+        state.close()
+
+    run(scenario())
+
+
+def test_double_spend_rejected_in_block(keys):
+    async def scenario():
+        state = ChainState()
+        manager = BlockManager(state, sig_backend="host")
+        await mine_and_accept(manager, state, keys["a1"], ts_offset=-3)
+
+        tx1 = await make_send(state, keys["d1"], keys["a1"], keys["a2"], 1 * SMALLEST)
+        tx2 = await make_send(state, keys["d1"], keys["a1"], keys["a2"], 2 * SMALLEST)
+        # tx1 and tx2 spend the same coinbase output -> block must be rejected
+        difficulty, last_block = await manager.calculate_difficulty()
+        header = BlockHeader(
+            previous_hash=last_block["hash"],
+            address=keys["a1"],
+            merkle_root=merkle_root([tx1, tx2]),
+            timestamp=timestamp(),
+            difficulty_x10=int(difficulty * 10),
+            nonce=0,
+        )
+        job = MiningJob(header.prefix_bytes(), last_block["hash"], difficulty)
+        result = mine(job, "python", batch=1 << 14, ttl=300)
+        header.nonce = result.nonce
+        errors = []
+        ok = await manager.create_block(header.hex(), [tx1, tx2], errors=errors)
+        assert not ok
+        assert any("double spend" in e for e in errors)
+        state.close()
+
+    run(scenario())
+
+
+def test_bad_signature_rejected(keys):
+    async def scenario():
+        state = ChainState()
+        manager = BlockManager(state, sig_backend="host")
+        await mine_and_accept(manager, state, keys["a1"], ts_offset=-3)
+        tx = await make_send(state, keys["d1"], keys["a1"], keys["a2"], 1 * SMALLEST)
+        r, s = tx.inputs[0].signature
+        tx.inputs[0].signature = (r, (s + 1) % (1 << 256))
+        verifier = TxVerifier(state)
+        assert not await verifier.verify(tx, sig_backend="host")
+        state.close()
+
+    run(scenario())
+
+
+def test_stake_flow_and_pending(keys):
+    async def scenario():
+        state = ChainState()
+        manager = BlockManager(state, sig_backend="host")
+        await mine_and_accept(manager, state, keys["a1"], ts_offset=-5)
+
+        # stake 3 coins: STAKE output to self + 10-power DELEGATE_VOTING_POWER
+        spendable = await state.get_spendable_outputs(keys["a1"])
+        total = sum(i.amount for i in spendable)
+        outputs = [
+            TxOutput(keys["a1"], 3 * SMALLEST, OutputType.STAKE),
+            TxOutput(keys["a1"], 10 * SMALLEST, OutputType.DELEGATE_VOTING_POWER),
+            TxOutput(keys["a1"], total - 3 * SMALLEST),
+        ]
+        tx = Tx(spendable, outputs)
+        from upow_tpu.core.codecs import string_to_point
+
+        tx.sign([keys["d1"]], lambda i: string_to_point(keys["a1"]))
+        verifier = TxVerifier(state)
+        assert await verifier.verify(tx, sig_backend="host"), "stake tx rejected"
+
+        await mine_and_accept(manager, state, keys["a1"], txs=[tx], ts_offset=-1)
+        stake = await state.get_address_stake(keys["a1"])
+        assert stake == Decimal(3)
+        power = await state.get_delegates_all_power(keys["a1"])
+        assert len(power) == 1
+
+        # a second stake without need must now fail (already staked)
+        spendable = await state.get_spendable_outputs(keys["a1"])
+        tx2 = Tx(spendable[:1], [TxOutput(keys["a1"], 1 * SMALLEST, OutputType.STAKE)])
+        tx2.sign([keys["d1"]], lambda i: string_to_point(keys["a1"]))
+        assert not await verifier.verify(tx2, sig_backend="host")
+        state.close()
+
+    run(scenario())
+
+
+def test_mempool_intake_and_gc(keys):
+    async def scenario():
+        state = ChainState()
+        manager = BlockManager(state, sig_backend="host")
+        await mine_and_accept(manager, state, keys["a1"], ts_offset=-3)
+
+        tx = await make_send(state, keys["d1"], keys["a1"], keys["a2"], 1 * SMALLEST)
+        verifier = TxVerifier(state)
+        assert await verifier.verify_pending(tx, sig_backend="host")
+        await state.add_pending_transaction(tx)
+        assert await state.get_pending_transactions_count() == 1
+
+        # the same outpoints again -> pending double spend
+        tx_again = await make_send(state, keys["d1"], keys["a1"], keys["a2"], 1 * SMALLEST)
+        assert not await verifier.verify_pending(tx_again, sig_backend="host")
+
+        # mine the pending tx; mempool must drain
+        await mine_and_accept(manager, state, keys["a1"],
+                              txs=[tx], ts_offset=-1)
+        assert await state.get_pending_transactions_count() == 0
+
+        # GC: craft a pending tx whose input no longer exists
+        ghost = await make_send(state, keys["d1"], keys["a1"], keys["a2"], 1 * SMALLEST)
+        await state.add_pending_transaction(ghost)
+        await state.remove_blocks(2)  # reorg invalidates the source output?
+        await manager.clear_pending_transactions()
+        # after GC the mempool contains only txs with live inputs
+        for h in [ghost.hash()]:
+            remaining = await state.pending_transaction_exists(h)
+            live = all(await state.outpoints_exist(
+                [i.outpoint for i in ghost.inputs]))
+            assert remaining == live
+        state.close()
+
+    run(scenario())

@@ -1,0 +1,184 @@
+"""Differential tests: TPU batched P-256 verify vs the pure-Python curve.
+
+The host implementation in upow_tpu.core.curve is itself tested against
+OpenSSL in test_core_tx.py; here it serves as the oracle for the limb
+field arithmetic, the complete-addition formulas, and the full batched
+verdicts — including adversarial/invalid signatures (the consensus
+surface: transaction_input.py:100-109 decides block validity).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from upow_tpu.core import curve
+from upow_tpu.core.constants import CURVE_N, CURVE_P
+from upow_tpu.crypto import fp
+from upow_tpu.crypto import p256
+
+rng = random.Random(99)
+
+_FS = fp.make_field(CURVE_P)
+
+
+def _fe(xs) -> fp.FE:
+    return fp.from_ints(xs, _FS)
+
+
+def _canon_ints(x: fp.FE):
+    return fp.limbs_to_ints(np.asarray(fp.canon(x, _FS)))
+
+
+# --- field arithmetic -----------------------------------------------------
+
+def _rand_fe():
+    return rng.randrange(CURVE_P)
+
+
+def test_fp_mont_mul_matches_bigint():
+    xs = [_rand_fe() for _ in range(8)] + [0, 1, CURVE_P - 1]
+    ys = [_rand_fe() for _ in range(8)] + [CURVE_P - 1, 1, CURVE_P - 1]
+    a = _fe([fp.to_mont(x, _FS) for x in xs])
+    b = _fe([fp.to_mont(y, _FS) for y in ys])
+    got = _canon_ints(fp.mont_mul(a, b, _FS))
+    want = [fp.to_mont(x * y % CURVE_P, _FS) for x, y in zip(xs, ys)]
+    assert got == want
+
+
+def test_fp_add_sub_edges_and_chains():
+    xs = [0, 1, CURVE_P - 1, CURVE_P - 1, 12345, 0]
+    ys = [0, CURVE_P - 1, CURVE_P - 1, 1, 54321, 1]
+    a, b = _fe(xs), _fe(ys)
+    assert _canon_ints(fp.add(a, b)) == [(x + y) % CURVE_P for x, y in zip(xs, ys)]
+    assert _canon_ints(fp.sub(a, b, _FS)) == [(x - y) % CURVE_P for x, y in zip(xs, ys)]
+    # chained lazy ops stay exact mod p: ((a+b)*2 - b) * (a - b) * R^-1
+    t = fp.sub(fp.add(fp.add(a, b), fp.add(a, b)), b, _FS)
+    u = fp.sub(a, b, _FS)
+    got = _canon_ints(fp.mont_mul(t, u, _FS))
+    want = [
+        ((2 * (x + y) - y) * (x - y) * pow(1 << fp.R_BITS, -1, CURVE_P)) % CURVE_P
+        for x, y in zip(xs, ys)
+    ]
+    assert got == want
+
+
+def test_fp_sub_deep_nesting_keeps_bounds_finite():
+    """Repeated sub/add chains must stay exact and within the bound cap."""
+    xs = [_rand_fe() for _ in range(4)]
+    ys = [_rand_fe() for _ in range(4)]
+    a, b = _fe(xs), _fe(ys)
+    t, want = a, list(xs)
+    for _ in range(6):
+        t = fp.sub(fp.add(t, t), b, _FS)
+        want = [(2 * w - y) % CURVE_P for w, y in zip(want, ys)]
+    # wash the bound back down through a multiply by R (== identity)
+    one_r2 = _fe([_FS.r2_mod_p] * 4)
+    t = fp.mont_mul(t, one_r2, _FS)
+    want = [w * (1 << fp.R_BITS) % CURVE_P for w in want]
+    assert _canon_ints(t) == want
+
+
+# --- complete point addition ---------------------------------------------
+
+def _to_proj_batch(points):
+    """affine (x,y) list (None = infinity) -> Proj of Montgomery FEs."""
+    xs = [fp.to_mont(0 if p is None else p[0], _FS) for p in points]
+    ys = [fp.to_mont(1 if p is None else p[1], _FS) for p in points]
+    zs = [fp.to_mont(0 if p is None else 1, _FS) for p in points]
+    return tuple(_fe(v) for v in (xs, ys, zs))
+
+
+def _from_proj_batch(P):
+    """device Proj -> affine (x, y) list via host inversion (None = inf)."""
+    X, Y, Z = (_canon_ints(c) for c in P)
+    out = []
+    rinv = pow(1 << fp.R_BITS, -1, CURVE_P)
+    for x, y, z in zip(X, Y, Z):
+        x, y, z = (v * rinv % CURVE_P for v in (x, y, z))
+        if z == 0:
+            out.append(None)
+        else:
+            zi = pow(z, -1, CURVE_P)
+            out.append((x * zi % CURVE_P, y * zi % CURVE_P))
+    return out
+
+
+def test_complete_add_random_and_edge_cases():
+    G = curve.G
+    P1 = curve.point_mul(rng.randrange(1, CURVE_N), G)
+    P2 = curve.point_mul(rng.randrange(1, CURVE_N), G)
+    neg_P1 = (P1[0], CURVE_P - P1[1])
+    cases = [
+        (P1, P2),          # generic
+        (P1, P1),          # doubling through the *addition* formula
+        (P1, neg_P1),      # inverse -> infinity
+        (None, P1),        # identity left
+        (P1, None),        # identity right
+        (None, None),      # identity both
+        (G, G),            # doubling the generator
+        (neg_P1, P1),      # inverse, flipped
+    ]
+    A = _to_proj_batch([c[0] for c in cases])
+    B = _to_proj_batch([c[1] for c in cases])
+    b_m = fp.const(p256._B_M, len(cases), CURVE_P)
+    got = _from_proj_batch(p256._point_add_complete(A, B, b_m))
+    want = [curve.point_add(a, b) for a, b in cases]
+    assert got == want
+
+
+def test_complete_add_chain_matches_scalar_mul():
+    """Fold the addition formula 16 times; compare against point_mul."""
+    G = curve.G
+    P = _to_proj_batch([G])
+    b_m = fp.const(p256._B_M, 1, CURVE_P)
+    acc = _to_proj_batch([None])
+    for _ in range(16):
+        acc = p256._clamp_point(p256._point_add_complete(acc, P, b_m))
+    assert _from_proj_batch(acc) == [curve.point_mul(16, G)]
+
+
+# --- full verify ----------------------------------------------------------
+
+def test_verify_batch_valid_and_invalid():
+    msgs, sigs, pubs, expect = [], [], [], []
+
+    for i in range(6):
+        d, pub = curve.keygen(rng=rng.randrange(1, CURVE_N))
+        msg = bytes([i]) * (i + 7)
+        r, s = curve.sign(msg, d)
+        msgs.append(msg)
+        sigs.append((r, s))
+        pubs.append(pub)
+        expect.append(True)
+
+    d, pub = curve.keygen(rng=rng.randrange(1, CURVE_N))
+    r, s = curve.sign(b"good message", d)
+    # tampered message
+    msgs.append(b"evil message"); sigs.append((r, s)); pubs.append(pub); expect.append(False)
+    # tampered r / s
+    msgs.append(b"good message"); sigs.append(((r + 1) % CURVE_N, s)); pubs.append(pub); expect.append(False)
+    msgs.append(b"good message"); sigs.append((r, (s + 1) % CURVE_N)); pubs.append(pub); expect.append(False)
+    # wrong key
+    _, pub2 = curve.keygen(rng=rng.randrange(1, CURVE_N))
+    msgs.append(b"good message"); sigs.append((r, s)); pubs.append(pub2); expect.append(False)
+    # out-of-range r/s
+    msgs.append(b"good message"); sigs.append((0, s)); pubs.append(pub); expect.append(False)
+    msgs.append(b"good message"); sigs.append((r, CURVE_N)); pubs.append(pub); expect.append(False)
+    # pubkey not on curve
+    msgs.append(b"good message"); sigs.append((r, s)); pubs.append((123, 456)); expect.append(False)
+    # (r, n-s) malleability twin is a valid signature under plain ECDSA
+    msgs.append(b"good message"); sigs.append((r, CURVE_N - s)); pubs.append(pub); expect.append(True)
+    # the original, to close the batch
+    msgs.append(b"good message"); sigs.append((r, s)); pubs.append(pub); expect.append(True)
+
+    got = p256.verify_batch(msgs, sigs, pubs)
+    oracle = [
+        curve.verify(sig, m, p) if isinstance(p, tuple) else False
+        for sig, m, p in zip(sigs, msgs, pubs)
+    ]
+    assert list(got) == oracle == expect
+
+
+def test_verify_batch_empty():
+    assert p256.verify_batch([], [], []).shape == (0,)

@@ -1,0 +1,73 @@
+"""Mining engine tests: every backend finds the same nonce; TTL/sharding."""
+
+import hashlib
+import random
+
+import pytest
+
+from upow_tpu import native
+from upow_tpu.mine.engine import MiningJob, mine
+from upow_tpu.mine.miner import build_job
+
+rng = random.Random(4242)
+
+
+def _job(difficulty="1") -> MiningJob:
+    from upow_tpu.core import curve, point_to_string
+
+    prev = bytes(rng.randrange(256) for _ in range(32)).hex()
+    _, pub = curve.keygen(rng=rng.randrange(1, 1 << 200))
+    addr = point_to_string(pub)
+    return MiningJob.from_header_fields(
+        previous_hash=prev,
+        address=addr,
+        merkle_root=hashlib.sha256(b"").hexdigest(),
+        timestamp=1_753_791_000,
+        difficulty=difficulty,
+    )
+
+
+backends = ["jnp", "python"] + (["native"] if native.load() is not None else [])
+
+
+@pytest.mark.parametrize("backend", backends)
+def test_backends_agree_on_first_hit(backend):
+    job = _job("1")
+    result = mine(job, backend, batch=4096, stride_end=1 << 16)
+    ref = mine(job, "python", batch=4096, stride_end=1 << 16)
+    assert result.nonce == ref.nonce
+    assert job.check(result.nonce)
+
+
+def test_mine_respects_ttl_and_range():
+    job = _job("9")  # unhittable in a tiny window
+    result = mine(job, "python", batch=256, stride_end=512, ttl=30)
+    assert result.nonce is None
+    assert result.hashes_tried == 512
+
+
+def test_shard_ranges_partition_nonce_space():
+    from upow_tpu.mine.engine import NONCE_SPACE
+
+    k = 8
+    bounds = [(NONCE_SPACE * i // k, NONCE_SPACE * (i + 1) // k) for i in range(k)]
+    assert bounds[0][0] == 0 and bounds[-1][1] == NONCE_SPACE
+    for (a, b), (c, d) in zip(bounds, bounds[1:]):
+        assert b == c
+
+
+def test_build_job_defaults_genesis():
+    from upow_tpu.core import curve, point_to_string
+
+    _, pub = curve.keygen(rng=12345)
+    info = {
+        "difficulty": 6.0,
+        "last_block": {},
+        "pending_transactions": [],
+        "pending_transactions_hashes": [],
+        "merkle_root": hashlib.sha256(b"").hexdigest(),
+    }
+    job, hashes, block_no = build_job(info, point_to_string(pub))
+    assert block_no == 1
+    assert hashes == []
+    assert job.previous_hash == (18_884_643).to_bytes(32, "little").hex()

@@ -1,0 +1,77 @@
+"""Sharded-kernel tests on the virtual 8-device CPU mesh (conftest.py)."""
+
+import hashlib
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from upow_tpu.core.difficulty import check_pow_hash
+from upow_tpu.crypto import SENTINEL, make_template, pow_search_jnp, target_spec
+from upow_tpu.parallel import make_mesh, pow_search_sharded, shard_bounds
+
+rng = random.Random(31337)
+
+
+def _rand_bytes(n):
+    return bytes(rng.randrange(256) for _ in range(n))
+
+
+def test_mesh_has_8_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_sharded_search_matches_single_device():
+    prefix = _rand_bytes(104)
+    template = make_template(prefix)
+    prev_hash = _rand_bytes(32).hex()
+    spec = target_spec(prev_hash, "1.5")
+    mesh = make_mesh()
+    per_dev = 1024
+    total = per_dev * len(jax.devices())
+    got = int(pow_search_sharded(template, spec, 0, per_dev, mesh))
+    want = int(pow_search_jnp(template, spec, nonce_base=0, batch=total))
+    assert got == want
+    if got != int(SENTINEL):
+        digest = hashlib.sha256(prefix + got.to_bytes(4, "little")).hexdigest()
+        assert check_pow_hash(digest, prev_hash, "1.5")
+
+
+def test_sharded_search_nonzero_base():
+    prefix = _rand_bytes(104)
+    template = make_template(prefix)
+    prev_hash = _rand_bytes(32).hex()
+    spec = target_spec(prev_hash, "1")
+    got = int(pow_search_sharded(template, spec, 1 << 16, 512))
+    want = int(pow_search_jnp(template, spec, nonce_base=1 << 16, batch=512 * 8))
+    assert got == want
+
+
+def test_shard_bounds_partition():
+    k = 4
+    parts = [shard_bounds(0, 1 << 32, i, k) for i in range(k)]
+    assert parts[0][0] == 0 and parts[-1][1] == 1 << 32
+    for (a, b), (c, d) in zip(parts, parts[1:]):
+        assert b == c
+
+
+def test_verify_batch_sharded_matches_unsharded():
+    """The verify program is elementwise over batch: sharded in == same out."""
+    from upow_tpu.core import curve
+    from upow_tpu.core.constants import CURVE_N
+    from upow_tpu.crypto import p256
+
+    msgs, sigs, pubs = [], [], []
+    for i in range(8):
+        d, pub = curve.keygen(rng=rng.randrange(1, CURVE_N))
+        msg = bytes([i]) * 11
+        r, s = curve.sign(msg, d)
+        if i % 2:
+            r = (r + 1) % CURVE_N
+        msgs.append(msg)
+        sigs.append((r, s))
+        pubs.append(pub)
+    got = p256.verify_batch(msgs, sigs, pubs)
+    want = [curve.verify(sig, m, p) for sig, m, p in zip(sigs, msgs, pubs)]
+    assert list(got) == want

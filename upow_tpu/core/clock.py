@@ -1,0 +1,10 @@
+"""UTC epoch timestamp, matching the reference's helpers.py:37-38."""
+
+from __future__ import annotations
+
+import time
+
+
+def timestamp() -> int:
+    """Whole seconds since the epoch, UTC."""
+    return int(time.time())

@@ -1,0 +1,249 @@
+"""Batched 256-bit prime-field arithmetic for TPU: 21×13-bit limbs, lazy.
+
+Design (SURVEY.md §2.2 — the role fastecdsa's C/GMP extension plays in the
+reference, transaction_input.py:100-109):
+
+* **13-bit limbs in int32 lanes** — a limb product is < 2²⁶ and a 21-term
+  accumulation stays < 2³¹, so schoolbook multiply + Montgomery reduction
+  run in plain int32 VPU ops with no u64 widening.
+* **Non-negative lazy representation with static bounds** — an element is
+  a (21, N) int32 array with limbs in [0, 2¹³] plus a *Python-side* upper
+  bound on the represented value, tracked exactly while tracing (the
+  fiat-crypto discipline).  Values stay congruent mod p but unreduced;
+  adds are one vector add + one carry sweep; subtraction is
+  ``a + (K·p − b)`` with the multiple K chosen statically from b's bound,
+  so limbs never go negative and carry sweeps can never lose a top carry
+  (every bound is asserted ≪ 2²⁷³ at trace time).
+* **One guard limb** (21 limbs = 273 bits for a 256-bit field) — gives
+  Montgomery products the slack that makes the lazy bounds self-stable:
+  with R = 2²⁷³, inputs bounded by ~2²⁶⁴ still return below 2p + ε.
+* **Array layout (L, N)** — limb index on the sublane axis, batch on the
+  lane axis; every op is a handful of large fused VPU instructions, which
+  keeps both the XLA graph small (fast compiles) and the TPU busy.  The
+  only sequential pieces are the per-site borrow chain inside ``sub`` and
+  the one exact reduction in :func:`canon` at the end of a verification.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+LIMB_BITS = 13
+NUM_LIMBS = 21
+LIMB_MASK = (1 << LIMB_BITS) - 1
+R_BITS = LIMB_BITS * NUM_LIMBS  # Montgomery R = 2^273
+
+# Hard cap on any element's value bound: far enough below 2^273 that a
+# carry sweep's top limb is always < 2^13 (no dropped carries), with room
+# for the K·p subtraction offsets.
+_BOUND_CAP = 1 << 270
+
+
+class FieldSpec(NamedTuple):
+    """Host-side constants for one prime field."""
+
+    p: int
+    p_col: np.ndarray          # (21, 1) int32 — broadcastable limb column
+    pinv: int                  # -p^-1 mod 2^13
+    r_mod_p: int               # R mod p  (Montgomery form of 1)
+    r2_mod_p: int              # R^2 mod p
+
+
+def make_field(p: int) -> FieldSpec:
+    return FieldSpec(
+        p=p,
+        p_col=int_to_limbs(p).reshape(NUM_LIMBS, 1),
+        pinv=(-pow(p, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS),
+        r_mod_p=(1 << R_BITS) % p,
+        r2_mod_p=pow(1 << R_BITS, 2, p),
+    )
+
+
+# --- host conversions -----------------------------------------------------
+
+def int_to_limbs(x: int) -> np.ndarray:
+    out = np.zeros(NUM_LIMBS, dtype=np.int32)
+    for i in range(NUM_LIMBS):
+        out[i] = x & LIMB_MASK
+        x >>= LIMB_BITS
+    assert x == 0, "value exceeds 273 bits"
+    return out
+
+
+def ints_to_limbs(xs) -> np.ndarray:
+    """list of ints -> (21, N) int32 batch."""
+    out = np.zeros((NUM_LIMBS, len(xs)), dtype=np.int32)
+    for j, x in enumerate(xs):
+        out[:, j] = int_to_limbs(x)
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    limbs = np.asarray(limbs, dtype=np.int64)
+    return sum(int(limbs[i]) << (LIMB_BITS * i) for i in range(limbs.shape[0]))
+
+
+def limbs_to_ints(limbs) -> list:
+    limbs = np.asarray(limbs)
+    return [limbs_to_int(limbs[:, j]) for j in range(limbs.shape[1])]
+
+
+def to_mont(x: int, fs: FieldSpec) -> int:
+    return x * (1 << R_BITS) % fs.p
+
+
+# --- the element type -----------------------------------------------------
+
+@dataclass(frozen=True)
+class FE:
+    """Field-element batch: (21, N) int32 limbs + static value bound.
+
+    ``bound`` is exclusive, tracked in Python while tracing — it never
+    touches the device.  Limbs are in [0, 2^13] (8192 inclusive, the
+    post-sweep residue), values are >= 0 and < bound.
+    """
+
+    arr: jnp.ndarray
+    bound: int
+
+    def __post_init__(self):
+        assert self.bound <= _BOUND_CAP, (
+            f"fp bound overflow: {self.bound.bit_length()} bits — "
+            "missing a mont_mul in the chain?")
+
+
+def wrap(arr, bound: int) -> FE:
+    return FE(arr, bound)
+
+
+def from_ints(xs, fs: FieldSpec) -> FE:
+    """Host canonical ints (< p) -> device FE."""
+    assert all(0 <= x < fs.p for x in xs)
+    return FE(jnp.asarray(ints_to_limbs(xs)), fs.p)
+
+
+def const(x: int, n: int, bound: int) -> FE:
+    """Broadcast one host int (< bound) to a (21, N) batch."""
+    return FE(
+        jnp.broadcast_to(
+            jnp.asarray(int_to_limbs(x).reshape(NUM_LIMBS, 1)), (NUM_LIMBS, n)
+        ),
+        bound,
+    )
+
+
+# --- device ops -----------------------------------------------------------
+
+def _sweep(t, rounds: int):
+    """Carry sweep: re-digitize non-negative limbs toward [0, 2^13].
+
+    Each round keeps the low 13 bits and moves the carry one limb up.
+    Safe to drop the top-limb carry: all values are non-negative and
+    bounded < 2^270 ≪ 2^273, so that carry is provably zero.
+    """
+    for _ in range(rounds):
+        c = t >> LIMB_BITS
+        t = (t & LIMB_MASK) + jnp.concatenate(
+            [jnp.zeros_like(c[:1]), c[:-1]], axis=0
+        )
+    return t
+
+
+def add(a: FE, b: FE) -> FE:
+    return FE(_sweep(a.arr + b.arr, 1), a.bound + b.bound)
+
+
+def _pow2_p_multiple(bound: int, p: int) -> int:
+    """Smallest K = 2^k · p with K >= bound (so K − b is non-negative)."""
+    k = 1
+    while k * p < bound:
+        k <<= 1
+    return k * p
+
+
+def sub(a: FE, b: FE, fs: FieldSpec) -> FE:
+    """a − b computed as a + (K·p − b), K statically chosen from b.bound."""
+    K = _pow2_p_multiple(b.bound, fs.p)
+    k_limbs = int_to_limbs(K)
+    # exact borrow chain for K − b (non-negative by construction of K)
+    limbs = []
+    c = jnp.zeros_like(b.arr[0])
+    for i in range(NUM_LIMBS):
+        v = int(k_limbs[i]) - b.arr[i] + c
+        limbs.append(v & LIMB_MASK)
+        c = v >> LIMB_BITS
+    neg_b = jnp.stack(limbs, axis=0)
+    return FE(_sweep(a.arr + neg_b, 1), a.bound + K)
+
+
+def mont_mul(a: FE, b: FE, fs: FieldSpec) -> FE:
+    """Montgomery product a·b·R⁻¹ mod p; bound resets to ~2p for sane inputs."""
+    L = NUM_LIMBS
+    n = a.arr.shape[1]
+    t = jnp.zeros((2 * L, n), dtype=jnp.int32)
+    for i in range(L):
+        t = t.at[i:i + L].add(a.arr[i] * b.arr)
+    t = _sweep(t, 3)
+    # Montgomery rounds: zero the bottom L limbs; the single-limb carry per
+    # round keeps m exact (t[i] ≡ value/b^i mod b at round i)
+    p_col = jnp.asarray(fs.p_col)
+    for i in range(L):
+        m = (t[i] * fs.pinv) & LIMB_MASK
+        t = t.at[i:i + L].add(m * p_col)
+        t = t.at[i + 1].add(t[i] >> LIMB_BITS)
+    out = _sweep(t[L:], 3)
+    return FE(out, a.bound * b.bound // (1 << R_BITS) + 2 * fs.p)
+
+
+def canon(a: FE, fs: FieldSpec):
+    """Exact canonical reduction to [0, p) with canonical limbs.
+
+    One sequential carry chain + log2(bound/p) conditional subtractions.
+    Used once per verification (final equality), not in the hot path.
+    """
+    limbs = []
+    c = jnp.zeros_like(a.arr[0])
+    for i in range(NUM_LIMBS):
+        v = a.arr[i] + c
+        limbs.append(v & LIMB_MASK)
+        c = v >> LIMB_BITS
+    t = jnp.stack(limbs, axis=0)
+    k = 1
+    while k * fs.p < a.bound:
+        k <<= 1
+    while k >= 1:
+        t = _cond_sub(t, k * fs.p)
+        k //= 2
+    return t
+
+
+def _cond_sub(t, m: int):
+    """t (canonical limbs) -> t − m if t >= m else t (exact borrow chain)."""
+    mc = int_to_limbs(m)
+    limbs = []
+    c = jnp.zeros_like(t[0])
+    for i in range(NUM_LIMBS):
+        v = t[i] - int(mc[i]) + c
+        limbs.append(v & LIMB_MASK)
+        c = v >> LIMB_BITS
+    ge = c == 0  # no net borrow -> t >= m
+    d = jnp.stack(limbs, axis=0)
+    return jnp.where(ge, d, t)
+
+
+def eq_zero_canon(a):
+    """all-limbs-zero test for an already-canonical array."""
+    return jnp.all(a == 0, axis=0)
+
+
+def is_zero_mod_p(a: FE, fs: FieldSpec):
+    return eq_zero_canon(canon(a, fs))
+
+
+def select(cond, a: FE, b: FE) -> FE:
+    """cond ? a : b; cond has shape (N,)."""
+    return FE(jnp.where(cond[None, :], a.arr, b.arr), max(a.bound, b.bound))

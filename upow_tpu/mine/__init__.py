@@ -1,0 +1,3 @@
+"""TPU-first mining: template → batched device nonce search → push_block."""
+
+from .engine import MiningJob, MineResult, mine, NONCE_SPACE

@@ -1,0 +1,145 @@
+"""Mining client CLI — wire-compatible with the reference miner.
+
+Usage:
+    python -m upow_tpu.mine.miner <address> [--device tpu|cpu|...]
+                                  [--node URL] [--batch N] [--ttl S]
+                                  [--shard i/k]
+
+Protocol (miner.py:126-156): GET {node}/get_mining_info → build a template
+(merkle over ALL pending tx hashes, miner.py:15-18,68), search nonces, POST
+{node}/push_block {block_content, txs, block_no}.  The ``--shard i/k`` flag
+assigns this process the i-th of k disjoint nonce ranges — the multi-chip /
+multi-host scale-out story (each shard is one device or one host; no
+communication needed until a hit).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from ..core.clock import timestamp
+from ..core.merkle import miner_merkle_root
+from .engine import NONCE_SPACE, MiningJob, mine
+
+GENESIS_PREV_HASH = (18_884_643).to_bytes(32, "little").hex()  # miner.py:37-40
+
+
+def _http_json(url: str, payload: Optional[dict] = None, timeout: float = 20.0) -> dict:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode() if payload is not None else None,
+        headers={"Content-Type": "application/json"} if payload is not None else {},
+        method="POST" if payload is not None else "GET",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def fetch_mining_info(node: str) -> dict:
+    return _http_json(node + "get_mining_info")["result"]
+
+
+def build_job(info: dict, address: str) -> tuple:
+    last_block = dict(info["last_block"])
+    last_block.setdefault("hash", GENESIS_PREV_HASH)
+    last_block.setdefault("id", 0)
+    pending_hashes = info["pending_transactions_hashes"]
+    job = MiningJob.from_header_fields(
+        previous_hash=last_block["hash"],
+        address=address,
+        merkle_root=miner_merkle_root(pending_hashes),
+        timestamp=timestamp(),
+        difficulty=info["difficulty"],
+    )
+    return job, pending_hashes, last_block["id"] + 1
+
+
+def push_block(node: str, block_content: str, txs: list, block_no: int) -> dict:
+    return _http_json(
+        node + "push_block",
+        {"block_content": block_content, "txs": txs, "block_no": block_no},
+        timeout=20 + len(txs) // 3,
+    )
+
+
+def select_backend(device: str) -> str:
+    if device in ("pallas", "jnp", "native", "python"):
+        return device
+    if device == "tpu":
+        return "pallas"
+    if device == "cpu":
+        from .. import native
+
+        return "native" if native.load() is not None else "jnp"
+    raise SystemExit(f"unknown device {device!r}")
+
+
+def run(address: str, node: str, device: str, batch: int, ttl: float,
+        shard: tuple = (0, 1), once: bool = False) -> int:
+    backend = select_backend(device)
+    i, k = shard
+    lo = NONCE_SPACE * i // k
+    hi = NONCE_SPACE * (i + 1) // k
+    print(f"upow_tpu miner: backend={backend} shard={i}/{k} "
+          f"nonces=[{lo}, {hi}) node={node}")
+    while True:
+        try:
+            info = fetch_mining_info(node)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"node unreachable: {e}; retrying", file=sys.stderr)
+            time.sleep(1)
+            continue
+        job, pending_hashes, block_no = build_job(info, address)
+        print(f"difficulty: {info['difficulty']}  block: {block_no}  "
+              f"confirming {len(pending_hashes)} transactions")
+
+        def progress(tried, elapsed):
+            print(f"{tried / elapsed / 1e6:.2f} MH/s ({tried} hashes)")
+
+        result = mine(job, backend, start=lo, stride_end=hi, batch=batch,
+                      ttl=ttl, progress=progress)
+        if result.nonce is None:
+            print(f"template expired after {result.hashes_tried} hashes; refreshing")
+            if once:
+                return 1
+            continue
+        content = job.block_content(result.nonce)
+        print(f"found nonce {result.nonce} at {result.hashrate / 1e6:.2f} MH/s")
+        try:
+            reply = push_block(node, content, pending_hashes, block_no)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"push_block failed: {e}", file=sys.stderr)
+            reply = {"ok": False}
+        print(reply)
+        if reply.get("ok"):
+            print("BLOCK MINED\n")
+        if once:
+            return 0 if reply.get("ok") else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="uPow TPU miner")
+    ap.add_argument("address")
+    ap.add_argument("--node", default="http://localhost:3006/")
+    ap.add_argument("--device", default="tpu",
+                    help="tpu|cpu or explicit backend pallas|jnp|native|python")
+    ap.add_argument("--batch", type=int, default=1 << 22)
+    ap.add_argument("--ttl", type=float, default=90.0)
+    ap.add_argument("--shard", default="0/1", help="i/k disjoint nonce-range shard")
+    ap.add_argument("--once", action="store_true", help="mine a single template and exit")
+    args = ap.parse_args(argv)
+    i, k = (int(x) for x in args.shard.split("/"))
+    assert 0 <= i < k, "--shard must be i/k with 0 <= i < k"
+    node = args.node.rstrip("/") + "/"
+    return run(args.address, node, args.device, args.batch, args.ttl,
+               shard=(i, k), once=args.once)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,116 @@
+"""ctypes bindings for the native C++ backends, built lazily with g++.
+
+The shared library compiles on first use into ``_build/`` next to this
+file (no pybind11 in the image; plain C ABI + ctypes).  If no compiler is
+available the module degrades gracefully: :func:`load` returns ``None``
+and callers fall back to hashlib / pure Python — the same layering the
+reference gets from hashlib/fastecdsa being optional C accelerators.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "upow_native.cpp")
+_LIB = os.path.join(_DIR, "_build", "libupow_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> bool:
+    gxx = shutil.which("g++")
+    if gxx is None:
+        return False
+    os.makedirs(os.path.dirname(_LIB), exist_ok=True)
+    cmd = [gxx, "-O3", "-fPIC", "-shared", "-std=c++17", _SRC, "-o", _LIB + ".tmp"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired):
+        return False
+    os.replace(_LIB + ".tmp", _LIB)
+    return True
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library, or None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _compile():
+                return None
+        lib = ctypes.CDLL(_LIB)
+        lib.upow_sha256.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+        lib.upow_sha256.restype = None
+        lib.upow_pow_search.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+        ]
+        lib.upow_pow_search.restype = ctypes.c_uint32
+        lib.upow_p256_verify.argtypes = [ctypes.c_char_p] * 5
+        lib.upow_p256_verify.restype = ctypes.c_int
+        lib.upow_p256_verify_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ]
+        lib.upow_p256_verify_batch.restype = None
+        _lib = lib
+        return _lib
+
+
+def sha256(message: bytes) -> Optional[bytes]:
+    lib = load()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(32)
+    lib.upow_sha256(message, len(message), out)
+    return out.raw
+
+
+def pow_search(prefix: bytes, target_prefix_hex: str, charset: int,
+               start: int, count: int) -> Optional[int]:
+    """First nonce in [start, start+count) passing the PoW rule, else None.
+
+    Mirrors the reference miner's hot loop (miner.py:83-98) at C speed.
+    Returns None also when the native library is unavailable.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    nibbles = bytes(int(c, 16) for c in target_prefix_hex)
+    hit = lib.upow_pow_search(prefix, len(prefix), nibbles, len(nibbles),
+                              charset, start, count)
+    return None if hit == 0xFFFFFFFF else hit
+
+
+def p256_verify(msg_digest: bytes, r: int, s: int, qx: int, qy: int) -> Optional[bool]:
+    lib = load()
+    if lib is None:
+        return None
+    be = lambda x: x.to_bytes(32, "big")
+    return bool(lib.upow_p256_verify(msg_digest, be(r), be(s), be(qx), be(qy)))
+
+
+def p256_verify_batch(digests, sigs, pubs) -> Optional[list]:
+    lib = load()
+    if lib is None:
+        return None
+    n = len(digests)
+    cat = lambda xs: b"".join(xs)
+    be = lambda x: x.to_bytes(32, "big")
+    out = ctypes.create_string_buffer(n)
+    lib.upow_p256_verify_batch(
+        cat(digests), cat(be(r) for r, _ in sigs), cat(be(s) for _, s in sigs),
+        cat(be(x) for x, _ in pubs), cat(be(y) for _, y in pubs), n, out,
+    )
+    return [bool(b) for b in out.raw]

@@ -1,0 +1,8 @@
+"""Mesh/sharding layer: ICI collectives for search + DP verify (SURVEY §2.3)."""
+
+from .mesh import (
+    make_mesh,
+    shard_bounds,
+    pow_search_sharded,
+    shard_batch_arrays,
+)

@@ -1,0 +1,4 @@
+"""Chain state: sqlite-backed storage + device-resident UTXO index."""
+
+from .storage import ChainState
+from .device_index import DeviceUtxoIndex

@@ -1,0 +1,98 @@
+"""Device-resident UTXO membership index (SURVEY.md §2.2, asyncpg row).
+
+The block-accept hot path tests every input outpoint against the unspent
+set (reference manager.py:531-615 does per-class SQL set-diffs).  Here the
+common case runs on device: outpoints are fingerprinted to 32 bits
+(first 4 bytes of sha256(tx_hash || index)), kept as ONE sorted int32
+array in HBM, and a whole block's inputs are tested with a single
+``searchsorted`` + gather-compare.
+
+The fingerprint is a *prefilter*, not the consensus decision:
+
+* fingerprint miss  -> outpoint is definitely NOT unspent (exact),
+* fingerprint hit   -> "maybe" — the host double-checks against storage.
+
+With ~1M UTXOs the false-positive rate is ~0.02% per lookup, so an
+8k-input block escalates a handful of host lookups while the other
+thousands short-circuit on device.  Rebuilds are a numpy sort (ms),
+refreshed per accepted block; the array is reconstructible from storage
+at any height (checkpoint/resume story, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Outpoint = Tuple[str, int]
+
+
+def fingerprint(outpoint: Outpoint) -> int:
+    tx_hash, index = outpoint
+    digest = hashlib.sha256(bytes.fromhex(tx_hash) + index.to_bytes(1, "little")).digest()
+    return int.from_bytes(digest[:4], "little", signed=True)  # int32 reinterpret
+
+
+@jax.jit
+def _member_mask(sorted_keys, queries):
+    pos = jnp.searchsorted(sorted_keys, queries)
+    pos = jnp.clip(pos, 0, sorted_keys.shape[0] - 1)
+    return sorted_keys[pos] == queries
+
+
+class DeviceUtxoIndex:
+    """Sorted-fingerprint membership prefilter, one per UTXO-class table."""
+
+    def __init__(self, outpoints: Iterable[Outpoint] = ()):
+        self._exact = set(outpoints)
+        self._dirty = True
+        self._keys = None
+
+    def __len__(self):
+        return len(self._exact)
+
+    def add(self, outpoints: Iterable[Outpoint]) -> None:
+        self._exact.update(outpoints)
+        self._dirty = True
+
+    def remove(self, outpoints: Iterable[Outpoint]) -> None:
+        self._exact.difference_update(outpoints)
+        self._dirty = True
+
+    def _device_keys(self):
+        if self._dirty:
+            keys = np.fromiter(
+                (fingerprint(o) for o in self._exact), dtype=np.int32,
+                count=len(self._exact),
+            )
+            keys.sort()
+            # pad to a non-empty power-of-two length to bound recompiles
+            n = max(1, 1 << (len(keys) - 1).bit_length()) if len(keys) else 1
+            pad = np.full(n - len(keys), np.iinfo(np.int32).max, dtype=np.int32)
+            self._keys = jnp.asarray(np.concatenate([keys, pad]))
+            self._dirty = False
+        return self._keys
+
+    def contains_batch(self, outpoints: Sequence[Outpoint]) -> List[bool]:
+        """Exact membership for a batch: device prefilter + host refinement."""
+        if not outpoints:
+            return []
+        queries = np.fromiter(
+            (fingerprint(o) for o in outpoints), dtype=np.int32,
+            count=len(outpoints),
+        )
+        n = 1 << (len(queries) - 1).bit_length() if len(queries) else 1
+        padded = np.concatenate([
+            queries, np.full(n - len(queries), np.iinfo(np.int32).min, np.int32)])
+        maybe = np.asarray(_member_mask(self._device_keys(), jnp.asarray(padded)))[
+            : len(outpoints)]
+        # fingerprint hit -> host-exact confirmation (collisions possible)
+        return [bool(m) and (o in self._exact) for m, o in zip(maybe, outpoints)]
+
+    def missing(self, outpoints: Sequence[Outpoint]) -> List[Outpoint]:
+        present = self.contains_batch(outpoints)
+        return [o for o, ok in zip(outpoints, present) if not ok]

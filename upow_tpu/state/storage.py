@@ -1,0 +1,874 @@
+"""Chain state storage: the role of the reference's ``Database`` singleton.
+
+The reference couples all chain state to asyncpg/PostgreSQL through an
+~80-method ``Database`` class (database.py, 1654 LoC).  This framework
+keeps the same *logical* schema (schema.sql: blocks, transactions, six
+UTXO-class tables, pending tables) but:
+
+* backs it with stdlib ``sqlite3`` (file or ``:memory:``) — a zero-dep,
+  durable, transactional store; the storage API is the seam where a
+  Postgres backend could be swapped in for reference interop,
+* keeps amounts as **int smallest-units** end to end (the reference's
+  NUMERIC/Decimal appears only in governance ratio math, which is
+  Decimal-exact here too — core/rewards.py),
+* avoids the reference's LIKE-'%hex%' address scans (database.py:864-937)
+  by materializing an ``address`` column on outputs and a JSON address
+  array on transactions,
+* exposes the *state-view* callbacks the pure consensus kernel needs
+  (core/tx.py ``AddressResolver``) instead of letting codecs import the
+  database (the circular-import knot SURVEY.md §1 flags).
+
+All methods are ``async def`` to slot into the asyncio node shell; sqlite
+calls are short and synchronous under a process-wide connection with WAL.
+Block acceptance is wrapped in one transaction (``atomic``) — the
+serializable-retry loop the reference hand-rolls (database.py:640-672)
+comes for free from sqlite's locking.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+from contextlib import asynccontextmanager
+from decimal import Decimal
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+from ..core.clock import timestamp as now_ts
+from ..core.codecs import OutputType, TransactionType
+from ..core.constants import SMALLEST
+from ..core.rewards import round_up_decimal
+from ..core.tx import CoinbaseTx, Tx, TxInput, tx_from_hex
+
+AnyTx = Union[Tx, CoinbaseTx]
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS blocks (
+    id INTEGER PRIMARY KEY,
+    hash TEXT UNIQUE NOT NULL,
+    content TEXT NOT NULL,
+    address TEXT NOT NULL,
+    random INTEGER NOT NULL,
+    difficulty TEXT NOT NULL,
+    reward INTEGER NOT NULL,
+    timestamp INTEGER NOT NULL
+);
+CREATE TABLE IF NOT EXISTS transactions (
+    block_hash TEXT NOT NULL,
+    tx_hash TEXT UNIQUE NOT NULL,
+    tx_hex TEXT NOT NULL,
+    inputs_addresses TEXT NOT NULL,
+    outputs_addresses TEXT NOT NULL,
+    outputs_amounts TEXT NOT NULL,
+    fees INTEGER NOT NULL
+);
+CREATE INDEX IF NOT EXISTS tx_block_hash_idx ON transactions (block_hash);
+CREATE TABLE IF NOT EXISTS pending_transactions (
+    tx_hash TEXT UNIQUE NOT NULL,
+    tx_hex TEXT NOT NULL,
+    inputs_addresses TEXT NOT NULL,
+    fees INTEGER NOT NULL,
+    propagation_time INTEGER NOT NULL
+);
+CREATE TABLE IF NOT EXISTS pending_spent_outputs (
+    tx_hash TEXT NOT NULL,
+    idx INTEGER NOT NULL
+);
+CREATE TABLE IF NOT EXISTS unspent_outputs (
+    tx_hash TEXT NOT NULL,
+    idx INTEGER NOT NULL,
+    address TEXT,
+    amount INTEGER NOT NULL,
+    is_stake INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (tx_hash, idx)
+);
+CREATE INDEX IF NOT EXISTS unspent_address_idx ON unspent_outputs (address);
+"""
+
+# The five governance tables share one row shape (outpoint + address).
+_GOV_TABLES = (
+    "inode_registration_output",
+    "validator_registration_output",
+    "validators_voting_power",
+    "delegates_voting_power",
+    "inodes_ballot",
+    "validators_ballot",
+)
+
+for _t in _GOV_TABLES:
+    _SCHEMA += f"""
+CREATE TABLE IF NOT EXISTS {_t} (
+    tx_hash TEXT NOT NULL,
+    idx INTEGER NOT NULL,
+    address TEXT,
+    amount INTEGER NOT NULL DEFAULT 0,
+    PRIMARY KEY (tx_hash, idx)
+);
+CREATE INDEX IF NOT EXISTS {_t}_address_idx ON {_t} (address);
+"""
+
+# OutputType -> table routing (reference database.py:524-580)
+_OUTPUT_TABLE = {
+    OutputType.REGULAR: "unspent_outputs",
+    OutputType.STAKE: "unspent_outputs",
+    OutputType.UN_STAKE: "unspent_outputs",
+    OutputType.INODE_REGISTRATION: "inode_registration_output",
+    OutputType.VALIDATOR_REGISTRATION: "validator_registration_output",
+    OutputType.VALIDATOR_VOTING_POWER: "validators_voting_power",
+    OutputType.DELEGATE_VOTING_POWER: "delegates_voting_power",
+    OutputType.VOTE_AS_VALIDATOR: "inodes_ballot",
+    OutputType.VOTE_AS_DELEGATE: "validators_ballot",
+}
+
+# TransactionType -> which table its *inputs* spend from
+# (reference database.py:589-622 remove_outputs partitioning)
+_INPUT_TABLE = {
+    TransactionType.INODE_DE_REGISTRATION: "inode_registration_output",
+    TransactionType.VOTE_AS_VALIDATOR: "validators_voting_power",
+    TransactionType.VOTE_AS_DELEGATE: "delegates_voting_power",
+    TransactionType.REVOKE_AS_VALIDATOR: "inodes_ballot",
+    TransactionType.REVOKE_AS_DELEGATE: "validators_ballot",
+}
+
+
+class ChainState:
+    """One chain's durable state.  ``path=None`` -> in-memory (tests)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or ":memory:"
+        self.db = sqlite3.connect(self.path)
+        self.db.row_factory = sqlite3.Row
+        if path:
+            self.db.execute("PRAGMA journal_mode=WAL")
+        self.db.execute("PRAGMA foreign_keys=OFF")
+        self.db.executescript(_SCHEMA)
+        self.db.commit()
+        # emission audit sidecar (reference: emission_details.json pickledb)
+        self.emission_path = (
+            os.path.splitext(path)[0] + ".emission.json" if path else None
+        )
+
+    def close(self):
+        self.db.close()
+
+    @asynccontextmanager
+    async def atomic(self):
+        """One transaction around a whole block acceptance."""
+        try:
+            self.db.execute("BEGIN")
+            yield
+            self.db.commit()
+        except BaseException:
+            self.db.rollback()
+            raise
+
+    # ------------------------------------------------------------- blocks --
+
+    async def add_block(self, block_id: int, block_hash: str, content: str,
+                        address: str, nonce: int, difficulty, reward: int,
+                        ts: int) -> None:
+        self.db.execute(
+            "INSERT INTO blocks (id, hash, content, address, random, difficulty,"
+            " reward, timestamp) VALUES (?,?,?,?,?,?,?,?)",
+            (block_id, block_hash, content, address, nonce, str(difficulty),
+             reward, ts),
+        )
+
+    @staticmethod
+    def _block_dict(r) -> dict:
+        return {
+            "id": r["id"],
+            "hash": r["hash"],
+            "content": r["content"],
+            "address": r["address"],
+            "random": r["random"],
+            "difficulty": Decimal(r["difficulty"]),
+            "reward": Decimal(r["reward"]) / SMALLEST,
+            "timestamp": r["timestamp"],
+        }
+
+    async def get_block(self, block_hash: str) -> Optional[dict]:
+        r = self.db.execute("SELECT * FROM blocks WHERE hash = ?", (block_hash,)).fetchone()
+        return self._block_dict(r) if r else None
+
+    async def get_block_by_id(self, block_id: int) -> Optional[dict]:
+        r = self.db.execute("SELECT * FROM blocks WHERE id = ?", (block_id,)).fetchone()
+        return self._block_dict(r) if r else None
+
+    async def get_last_block(self) -> Optional[dict]:
+        r = self.db.execute("SELECT * FROM blocks ORDER BY id DESC LIMIT 1").fetchone()
+        return self._block_dict(r) if r else None
+
+    async def get_next_block_id(self) -> int:
+        r = self.db.execute("SELECT MAX(id) AS m FROM blocks").fetchone()
+        return (r["m"] or 0) + 1
+
+    async def get_blocks(self, offset: int, limit: int) -> List[dict]:
+        """Blocks with embedded full transactions, ordered by id
+        (reference database.py:380-437's get_blocks)."""
+        rows = self.db.execute(
+            "SELECT * FROM blocks WHERE id >= ? ORDER BY id LIMIT ?",
+            (offset, limit),
+        ).fetchall()
+        out = []
+        for r in rows:
+            txs = self.db.execute(
+                "SELECT tx_hex FROM transactions WHERE block_hash = ?",
+                (r["hash"],),
+            ).fetchall()
+            block = self._block_dict(r)
+            block["difficulty"] = float(block["difficulty"])
+            block["reward"] = str(block["reward"])
+            out.append({
+                "block": block,
+                "transactions": [t["tx_hex"] for t in txs],
+            })
+        return out
+
+    async def remove_blocks(self, from_block_id: int) -> None:
+        """Reorg rollback: restore outputs spent by the removed blocks, drop
+        the blocks and everything their transactions created
+        (reference database.py:146-169)."""
+        rows = self.db.execute(
+            "SELECT t.tx_hex FROM transactions t JOIN blocks b ON t.block_hash = b.hash"
+            " WHERE b.id >= ?", (from_block_id,),
+        ).fetchall()
+        txs = [tx_from_hex(r["tx_hex"], check_signatures=False) for r in rows]
+        # drop outputs created by removed txs (from whichever table)
+        created = [tx.hash() for tx in txs]
+        for table in ("unspent_outputs",) + _GOV_TABLES:
+            self.db.executemany(
+                f"DELETE FROM {table} WHERE tx_hash = ?", [(h,) for h in created]
+            )
+        # restore outputs their inputs had spent
+        restore = [
+            tx_input for tx in txs if not tx.is_coinbase for tx_input in tx.inputs
+        ]
+        await self._restore_spent_outputs(restore)
+        self.db.executemany(
+            "DELETE FROM transactions WHERE tx_hash = ?", [(h,) for h in created]
+        )
+        self.db.execute("DELETE FROM blocks WHERE id >= ?", (from_block_id,))
+        self.db.commit()
+
+    async def _restore_spent_outputs(self, inputs: List[TxInput]) -> None:
+        """Re-materialize spent outputs by decoding their source txs."""
+        for tx_input in inputs:
+            src = await self.get_transaction(tx_input.tx_hash, include_pending=False)
+            if src is None:
+                continue
+            out = src.outputs[tx_input.index]
+            table = _OUTPUT_TABLE[out.output_type]
+            if table == "unspent_outputs":
+                self.db.execute(
+                    "INSERT OR IGNORE INTO unspent_outputs (tx_hash, idx, address,"
+                    " amount, is_stake) VALUES (?,?,?,?,?)",
+                    (tx_input.tx_hash, tx_input.index, out.address, out.amount,
+                     int(out.is_stake)),
+                )
+            else:
+                self.db.execute(
+                    f"INSERT OR IGNORE INTO {table} (tx_hash, idx, address, amount)"
+                    " VALUES (?,?,?,?)",
+                    (tx_input.tx_hash, tx_input.index, out.address, out.amount),
+                )
+
+    # ------------------------------------------------------- transactions --
+
+    async def add_transaction(self, tx: AnyTx, block_hash: str) -> None:
+        await self.add_transactions([tx], block_hash)
+
+    async def add_transactions(self, txs: Sequence[AnyTx], block_hash: str) -> None:
+        rows = []
+        for tx in txs:
+            inputs_addresses = [] if tx.is_coinbase else [
+                await self.resolve_output_address(i.tx_hash, i.index) or ""
+                for i in tx.inputs
+            ]
+            fees = 0 if tx.is_coinbase else await self.tx_fees(tx)
+            rows.append((
+                block_hash, tx.hash(), tx.hex(),
+                json.dumps(inputs_addresses),
+                json.dumps([o.address for o in tx.outputs]),
+                json.dumps([o.amount for o in tx.outputs]),
+                fees,
+            ))
+        self.db.executemany(
+            "INSERT OR REPLACE INTO transactions (block_hash, tx_hash, tx_hex,"
+            " inputs_addresses, outputs_addresses, outputs_amounts, fees)"
+            " VALUES (?,?,?,?,?,?,?)", rows,
+        )
+
+    async def get_transaction(self, tx_hash: str,
+                              include_pending: bool = False) -> Optional[AnyTx]:
+        r = self.db.execute(
+            "SELECT tx_hex FROM transactions WHERE tx_hash = ?", (tx_hash,)
+        ).fetchone()
+        if r is None and include_pending:
+            r = self.db.execute(
+                "SELECT tx_hex FROM pending_transactions WHERE tx_hash = ?",
+                (tx_hash,),
+            ).fetchone()
+        return tx_from_hex(r["tx_hex"], check_signatures=False) if r else None
+
+    async def get_transaction_info(self, tx_hash: str) -> Optional[dict]:
+        r = self.db.execute(
+            "SELECT * FROM transactions WHERE tx_hash = ?", (tx_hash,)
+        ).fetchone()
+        if r is None:
+            return None
+        return {
+            "block_hash": r["block_hash"],
+            "tx_hash": r["tx_hash"],
+            "tx_hex": r["tx_hex"],
+            "inputs_addresses": json.loads(r["inputs_addresses"]),
+            "outputs_addresses": json.loads(r["outputs_addresses"]),
+            "outputs_amounts": json.loads(r["outputs_amounts"]),
+            "fees": r["fees"],
+        }
+
+    async def get_transactions_info(self, tx_hashes: Iterable[str]) -> Dict[str, dict]:
+        out = {}
+        for h in tx_hashes:
+            info = await self.get_transaction_info(h)
+            if info is not None:
+                out[h] = info
+        return out
+
+    async def get_block_transactions(self, block_hash: str,
+                                     hex_only: bool = False) -> List:
+        rows = self.db.execute(
+            "SELECT tx_hex FROM transactions WHERE block_hash = ?", (block_hash,)
+        ).fetchall()
+        if hex_only:
+            return [r["tx_hex"] for r in rows]
+        return [tx_from_hex(r["tx_hex"], check_signatures=False) for r in rows]
+
+    async def resolve_output_address(self, tx_hash: str, index: int) -> Optional[str]:
+        """AddressResolver for the codec's ambiguous-signature relink
+        (core/tx.py tx_from_hex)."""
+        r = self.db.execute(
+            "SELECT outputs_addresses FROM transactions WHERE tx_hash = ?",
+            (tx_hash,),
+        ).fetchone()
+        if r is None:
+            r = self.db.execute(
+                "SELECT tx_hex FROM pending_transactions WHERE tx_hash = ?",
+                (tx_hash,),
+            ).fetchone()
+            if r is None:
+                return None
+            tx = tx_from_hex(r["tx_hex"], check_signatures=False)
+            return tx.outputs[index].address if index < len(tx.outputs) else None
+        addresses = json.loads(r["outputs_addresses"])
+        return addresses[index] if index < len(addresses) else None
+
+    async def tx_fees(self, tx: AnyTx) -> int:
+        """fee = Σ input amounts − Σ output amounts (int smallest units)."""
+        if tx.is_coinbase:
+            return 0
+        total_in = 0
+        for i in tx.inputs:
+            amount = await self.get_output_amount(i.tx_hash, i.index)
+            if amount is None:
+                return 0
+            total_in += amount
+        return tx.fees(total_in)
+
+    async def get_output_amount(self, tx_hash: str, index: int) -> Optional[int]:
+        r = self.db.execute(
+            "SELECT outputs_amounts FROM transactions WHERE tx_hash = ?",
+            (tx_hash,),
+        ).fetchone()
+        if r is not None:
+            amounts = json.loads(r["outputs_amounts"])
+            return amounts[index] if index < len(amounts) else None
+        r = self.db.execute(
+            "SELECT tx_hex FROM pending_transactions WHERE tx_hash = ?", (tx_hash,)
+        ).fetchone()
+        if r is None:
+            return None
+        tx = tx_from_hex(r["tx_hex"], check_signatures=False)
+        return tx.outputs[index].amount if index < len(tx.outputs) else None
+
+    # ------------------------------------------------------------ mempool --
+
+    async def add_pending_transaction(self, tx: Tx) -> None:
+        inputs_addresses = [
+            await self.resolve_output_address(i.tx_hash, i.index) or ""
+            for i in tx.inputs
+        ]
+        fees = await self.tx_fees(tx)
+        self.db.execute(
+            "INSERT INTO pending_transactions (tx_hash, tx_hex, inputs_addresses,"
+            " fees, propagation_time) VALUES (?,?,?,?,?)",
+            (tx.hash(), tx.hex(), json.dumps(inputs_addresses), fees, now_ts()),
+        )
+        self.db.executemany(
+            "INSERT INTO pending_spent_outputs (tx_hash, idx) VALUES (?,?)",
+            [(i.tx_hash, i.index) for i in tx.inputs],
+        )
+        self.db.commit()
+
+    async def pending_transaction_exists(self, tx_hash: str) -> bool:
+        r = self.db.execute(
+            "SELECT 1 FROM pending_transactions WHERE tx_hash = ?", (tx_hash,)
+        ).fetchone()
+        return r is not None
+
+    async def get_pending_transactions_limit(
+        self, limit_hex_chars: int = 4096 * 1024, hex_only: bool = False
+    ) -> List:
+        """Fee-rate-ordered mempool slice capped by total hex size
+        (reference database.py:171-186 ORDER BY fees/LENGTH(tx_hex) DESC,
+        cap MAX_BLOCK_SIZE_HEX)."""
+        rows = self.db.execute(
+            "SELECT tx_hex FROM pending_transactions ORDER BY"
+            " CAST(fees AS REAL)/LENGTH(tx_hex) DESC, tx_hash"
+        ).fetchall()
+        out, total = [], 0
+        for r in rows:
+            if total + len(r["tx_hex"]) > limit_hex_chars:
+                break
+            total += len(r["tx_hex"])
+            out.append(r["tx_hex"])
+        if hex_only:
+            return out
+        return [tx_from_hex(h, check_signatures=False) for h in out]
+
+    async def get_pending_transactions_by_hash(self, hashes: List[str]) -> List[str]:
+        out = []
+        for h in hashes:
+            r = self.db.execute(
+                "SELECT tx_hex FROM pending_transactions WHERE tx_hash = ?", (h,)
+            ).fetchone()
+            if r is not None:
+                out.append(r["tx_hex"])
+        return out
+
+    async def get_pending_spent_outpoints(self) -> set:
+        rows = self.db.execute(
+            "SELECT tx_hash, idx FROM pending_spent_outputs").fetchall()
+        return {(r["tx_hash"], r["idx"]) for r in rows}
+
+    async def remove_pending_transactions_by_hash(self, hashes: List[str]) -> None:
+        for h in hashes:
+            tx = await self.get_transaction(h, include_pending=True)
+            if tx is not None and not tx.is_coinbase:
+                self.db.executemany(
+                    "DELETE FROM pending_spent_outputs WHERE tx_hash = ? AND idx = ?",
+                    [(i.tx_hash, i.index) for i in tx.inputs],
+                )
+            self.db.execute(
+                "DELETE FROM pending_transactions WHERE tx_hash = ?", (h,))
+        self.db.commit()
+
+    async def remove_pending_transactions(self) -> None:
+        self.db.execute("DELETE FROM pending_transactions")
+        self.db.execute("DELETE FROM pending_spent_outputs")
+        self.db.commit()
+
+    async def get_pending_transactions_count(self) -> int:
+        return self.db.execute(
+            "SELECT COUNT(*) AS c FROM pending_transactions").fetchone()["c"]
+
+    async def get_need_propagate_transactions(self, older_than: int = 300) -> List[str]:
+        """Piggyback re-propagation queue (reference database.py:188-207)."""
+        rows = self.db.execute(
+            "SELECT tx_hex FROM pending_transactions WHERE propagation_time < ?",
+            (now_ts() - older_than,),
+        ).fetchall()
+        return [r["tx_hex"] for r in rows]
+
+    async def update_pending_transaction_propagation(self, tx_hash: str) -> None:
+        self.db.execute(
+            "UPDATE pending_transactions SET propagation_time = ? WHERE tx_hash = ?",
+            (now_ts(), tx_hash),
+        )
+        self.db.commit()
+
+    # --------------------------------------------------------------- UTXO --
+
+    async def add_transaction_outputs(self, txs: Sequence[AnyTx]) -> None:
+        """Route every output into its UTXO-class table
+        (reference database.py:524-580)."""
+        for tx in txs:
+            h = tx.hash()
+            for index, out in enumerate(tx.outputs):
+                table = _OUTPUT_TABLE[out.output_type]
+                if table == "unspent_outputs":
+                    self.db.execute(
+                        "INSERT OR REPLACE INTO unspent_outputs (tx_hash, idx,"
+                        " address, amount, is_stake) VALUES (?,?,?,?,?)",
+                        (h, index, out.address, out.amount, int(out.is_stake)),
+                    )
+                else:
+                    self.db.execute(
+                        f"INSERT OR REPLACE INTO {table} (tx_hash, idx, address,"
+                        " amount) VALUES (?,?,?,?)",
+                        (h, index, out.address, out.amount),
+                    )
+
+    async def remove_outputs(self, txs: Sequence[AnyTx]) -> None:
+        """Spend inputs from the table their tx type targets
+        (reference database.py:589-622)."""
+        for tx in txs:
+            if tx.is_coinbase:
+                continue
+            table = _INPUT_TABLE.get(tx.transaction_type, "unspent_outputs")
+            self.db.executemany(
+                f"DELETE FROM {table} WHERE tx_hash = ? AND idx = ?",
+                [(i.tx_hash, i.index) for i in tx.inputs],
+            )
+
+    async def get_unspent_outpoints(self, table: str = "unspent_outputs") -> set:
+        rows = self.db.execute(f"SELECT tx_hash, idx FROM {table}").fetchall()
+        return {(r["tx_hash"], r["idx"]) for r in rows}
+
+    async def outpoints_exist(self, outpoints: List[Tuple[str, int]],
+                              table: str = "unspent_outputs") -> List[bool]:
+        out = []
+        for tx_hash, idx in outpoints:
+            r = self.db.execute(
+                f"SELECT 1 FROM {table} WHERE tx_hash = ? AND idx = ?",
+                (tx_hash, idx),
+            ).fetchone()
+            out.append(r is not None)
+        return out
+
+    async def get_unspent_outputs_hash(self) -> str:
+        """UTXO-set fingerprint: sha256 over the sorted outpoint list —
+        the cross-node state-equality oracle (reference database.py:827-830,
+        logged every 10 blocks, exposed at GET /)."""
+        import hashlib
+
+        rows = self.db.execute(
+            "SELECT tx_hash, idx FROM unspent_outputs ORDER BY tx_hash, idx"
+        ).fetchall()
+        h = hashlib.sha256()
+        for r in rows:
+            h.update(f"{r['tx_hash']}{r['idx']}".encode())
+        return h.hexdigest()
+
+    # ------------------------------------------------------ address views --
+
+    async def get_spendable_outputs(self, address: str,
+                                    check_pending_txs: bool = False) -> List[TxInput]:
+        """REGULAR/UN_STAKE outputs owned by the address, minus anything in
+        the pending-spent overlay when requested."""
+        rows = self.db.execute(
+            "SELECT tx_hash, idx, amount, is_stake FROM unspent_outputs"
+            " WHERE address = ? AND is_stake = 0", (address,),
+        ).fetchall()
+        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
+        out = []
+        for r in rows:
+            if (r["tx_hash"], r["idx"]) in pending:
+                continue
+            i = TxInput(r["tx_hash"], r["idx"])
+            i.amount = r["amount"]
+            out.append(i)
+        return out
+
+    async def get_stake_outputs(self, address: str,
+                                check_pending_txs: bool = False) -> List[TxInput]:
+        rows = self.db.execute(
+            "SELECT tx_hash, idx, amount FROM unspent_outputs"
+            " WHERE address = ? AND is_stake = 1", (address,),
+        ).fetchall()
+        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
+        out = []
+        for r in rows:
+            if (r["tx_hash"], r["idx"]) in pending:
+                continue
+            i = TxInput(r["tx_hash"], r["idx"])
+            i.amount = r["amount"]
+            out.append(i)
+        return out
+
+    async def get_address_balance(self, address: str,
+                                  check_pending_txs: bool = False) -> int:
+        """Spendable balance in smallest units; ``check_pending_txs`` adds
+        unconfirmed incoming REGULAR outputs (reference database.py:1138-1186)."""
+        balance = sum(i.amount for i in await self.get_spendable_outputs(
+            address, check_pending_txs=check_pending_txs))
+        if check_pending_txs:
+            rows = self.db.execute(
+                "SELECT tx_hex FROM pending_transactions").fetchall()
+            for r in rows:
+                tx = tx_from_hex(r["tx_hex"], check_signatures=False)
+                for out in tx.outputs:
+                    if out.address == address and out.output_type == OutputType.REGULAR:
+                        balance += out.amount
+        return balance
+
+    async def get_address_stake(self, address: str,
+                                check_pending_txs: bool = False) -> Decimal:
+        """Staked coins as Decimal (governance ratio math is Decimal-exact;
+        reference database.py:1189-1205)."""
+        stake = sum(i.amount for i in await self.get_stake_outputs(
+            address, check_pending_txs=check_pending_txs))
+        stake = Decimal(stake) / SMALLEST
+        if check_pending_txs:
+            rows = self.db.execute("SELECT tx_hex FROM pending_transactions").fetchall()
+            for r in rows:
+                tx = tx_from_hex(r["tx_hex"], check_signatures=False)
+                for out in tx.outputs:
+                    if out.address == address and out.is_stake:
+                        stake += Decimal(out.amount) / SMALLEST
+        return stake
+
+    async def get_address_transactions(self, address: str, limit: int = 50,
+                                       offset: int = 0) -> List[dict]:
+        rows = self.db.execute(
+            "SELECT t.*, b.id AS block_id, b.timestamp AS block_ts FROM transactions t"
+            " JOIN blocks b ON b.hash = t.block_hash"
+            " WHERE t.inputs_addresses LIKE ? OR t.outputs_addresses LIKE ?"
+            " ORDER BY b.id DESC LIMIT ? OFFSET ?",
+            (f'%"{address}"%', f'%"{address}"%', limit, offset),
+        ).fetchall()
+        return [dict(r) for r in rows]
+
+    # --------------------------------------------------------- governance --
+
+    async def get_registered(self, table: str,
+                             check_pending_txs: bool = False) -> List[Tuple[str, int]]:
+        """(address, registered_at block timestamp) per registration output."""
+        rows = self.db.execute(
+            f"SELECT g.tx_hash, g.idx, g.address FROM {table} g").fetchall()
+        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
+        out = []
+        for r in rows:
+            if (r["tx_hash"], r["idx"]) in pending:
+                continue
+            ts = self.db.execute(
+                "SELECT b.timestamp AS ts FROM transactions t JOIN blocks b"
+                " ON b.hash = t.block_hash WHERE t.tx_hash = ?",
+                (r["tx_hash"],),
+            ).fetchone()
+            out.append((r["address"], ts["ts"] if ts else now_ts()))
+        return out
+
+    async def is_inode_registered(self, address: str,
+                                  check_pending_txs: bool = False) -> bool:
+        return any(a == address for a, _ in await self.get_registered(
+            "inode_registration_output", check_pending_txs))
+
+    async def is_validator_registered(self, address: str,
+                                      check_pending_txs: bool = False) -> bool:
+        return any(a == address for a, _ in await self.get_registered(
+            "validator_registration_output", check_pending_txs))
+
+    async def get_ballot_by_recipient(self, table: str, recipient: str,
+                                      check_pending_txs: bool = False) -> List[dict]:
+        """Standing votes FOR ``recipient``.
+
+        A ballot row is a vote *output*: its address column holds the vote
+        RECIPIENT (the inode/validator being voted for); the VOTER is the
+        vote transaction's ``inputs_addresses[output_index]`` (reference
+        database.py:939-1063 — SQL 1-based ``inputs_addresses[index+1]``),
+        and the vote count is the output's amount.
+        """
+        rows = self.db.execute(
+            f"SELECT g.tx_hash, g.idx, g.amount FROM {table} g WHERE g.address = ?",
+            (recipient,),
+        ).fetchall()
+        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
+        out = []
+        for r in rows:
+            if (r["tx_hash"], r["idx"]) in pending:
+                continue
+            info = await self.get_transaction_info(r["tx_hash"])
+            voter = None
+            if info is not None and r["idx"] < len(info["inputs_addresses"]):
+                voter = info["inputs_addresses"][r["idx"]]
+            out.append({
+                "tx_hash": r["tx_hash"], "index": r["idx"],
+                "voter": voter, "vote": Decimal(r["amount"]) / SMALLEST,
+            })
+        return out
+
+    async def get_votes_by_voter(self, table: str, voter: str,
+                                 check_pending_txs: bool = False) -> List[dict]:
+        """Standing votes cast BY ``voter`` (reference database.py:1557-1581
+        get_delegates_spent_votes shape: match on inputs_addresses[idx])."""
+        rows = self.db.execute(
+            f"SELECT g.tx_hash, g.idx, g.address, g.amount FROM {table} g"
+        ).fetchall()
+        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
+        out = []
+        for r in rows:
+            if (r["tx_hash"], r["idx"]) in pending:
+                continue
+            info = await self.get_transaction_info(r["tx_hash"])
+            if info is None or r["idx"] >= len(info["inputs_addresses"]):
+                continue
+            if info["inputs_addresses"][r["idx"]] != voter:
+                continue
+            out.append({
+                "tx_hash": r["tx_hash"], "index": r["idx"],
+                "recipient": r["address"], "vote": Decimal(r["amount"]) / SMALLEST,
+            })
+        return out
+
+    async def get_validators_stake(self, validator: str,
+                                   check_pending_txs: bool = False) -> Decimal:
+        """Σ (vote × delegate stake) / 10 over the validator's ballot
+        (reference database.py:1127-1136)."""
+        ballot = await self.get_ballot_by_recipient(
+            "validators_ballot", validator, check_pending_txs)
+        total = Decimal(0)
+        for entry in ballot:
+            if entry["voter"] is None:
+                continue
+            stake = await self.get_address_stake(entry["voter"], check_pending_txs)
+            total += entry["vote"] * stake / 10
+        return round_up_decimal(total)
+
+    async def get_inode_vote_ratio_by_address(self, inode: str,
+                                              check_pending_txs: bool = False) -> Decimal:
+        """Σ (vote × validator stake) / 10 over votes FOR this inode
+        (reference database.py:1390-1418)."""
+        ballot = await self.get_ballot_by_recipient(
+            "inodes_ballot", inode, check_pending_txs)
+        total = Decimal(0)
+        for entry in ballot:
+            if entry["voter"] is None:
+                continue
+            stake = await self.get_validators_stake(entry["voter"], check_pending_txs)
+            total += entry["vote"] * stake / 10
+        return round_up_decimal(total)
+
+    async def get_active_inodes(self, check_pending_txs: bool = False) -> List[dict]:
+        """Registered inodes with power/emission; active = emission >= 1% or
+        registered within 48 h (reference database.py:1377-1388)."""
+        registered = await self.get_registered(
+            "inode_registration_output", check_pending_txs)
+        details = []
+        for address, registered_at in registered:
+            power = await self.get_inode_vote_ratio_by_address(
+                address, check_pending_txs)
+            details.append({
+                "wallet": address, "power": power, "registered_at": registered_at,
+            })
+        total_power = sum(d["power"] for d in details)
+        active = []
+        for d in details:
+            emission = (
+                d["power"] / total_power * 100 if total_power > 0 else d["power"]
+            )
+            d["emission"] = round_up_decimal(emission, round_up_length="0.01")
+            is_active = d["emission"] >= 1 or (now_ts() - d["registered_at"]) <= 48 * 3600
+            if is_active:
+                active.append(d)
+        return active
+
+    async def get_transaction_block_timestamp(self, tx_hash: str) -> Optional[int]:
+        r = self.db.execute(
+            "SELECT b.timestamp AS ts FROM transactions t JOIN blocks b ON"
+            " b.hash = t.block_hash WHERE t.tx_hash = ?", (tx_hash,),
+        ).fetchone()
+        return r["ts"] if r else None
+
+    async def is_revoke_valid(self, tx_hash: str) -> bool:
+        """A vote can be revoked 48 h after the block that recorded it
+        (reference database.py:1073-1076)."""
+        ts = await self.get_transaction_block_timestamp(tx_hash)
+        return ts is not None and now_ts() - ts >= 48 * 3600
+
+    async def get_delegates_voting_power(self, address: str,
+                                         check_pending_txs: bool = False) -> List[Tuple[str, int]]:
+        rows = self.db.execute(
+            "SELECT tx_hash, idx FROM delegates_voting_power WHERE address = ?",
+            (address,),
+        ).fetchall()
+        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
+        return [(r["tx_hash"], r["idx"]) for r in rows
+                if (r["tx_hash"], r["idx"]) not in pending]
+
+    async def get_delegates_spent_votes(self, address: str,
+                                        check_pending_txs: bool = False) -> List[dict]:
+        """Standing delegate votes by this address (reference
+        database.py:1557-1581) — unstake requires these released."""
+        return await self.get_votes_by_voter(
+            "validators_ballot", address, check_pending_txs)
+
+    async def get_delegates_all_power(self, address: str,
+                                      check_pending_txs: bool = False) -> list:
+        """Unspent voting power plus standing votes (database.py:1583-1587)."""
+        power = list(await self.get_delegates_voting_power(address, check_pending_txs))
+        power.extend(
+            (v["tx_hash"], v["index"])
+            for v in await self.get_delegates_spent_votes(address, check_pending_txs))
+        return power
+
+    async def get_pending_stake_transactions(self, address: str) -> List[Tx]:
+        """Pending txs that stake for this address (database.py:1157-1172)."""
+        rows = self.db.execute("SELECT tx_hex FROM pending_transactions").fetchall()
+        out = []
+        for r in rows:
+            tx = tx_from_hex(r["tx_hex"], check_signatures=False)
+            if any(o.address == address and o.is_stake for o in tx.outputs):
+                out.append(tx)
+        return out
+
+    async def get_pending_vote_as_delegate_transactions(self, address: str) -> List[Tx]:
+        """Pending VOTE_AS_DELEGATE txs whose first input is this address
+        (database.py:1174-1187)."""
+        rows = self.db.execute("SELECT tx_hex FROM pending_transactions").fetchall()
+        out = []
+        for r in rows:
+            tx = tx_from_hex(r["tx_hex"], check_signatures=False)
+            if tx.transaction_type != TransactionType.VOTE_AS_DELEGATE or tx.is_coinbase:
+                continue
+            if not tx.inputs:
+                continue
+            first = await self.resolve_output_address(
+                tx.inputs[0].tx_hash, tx.inputs[0].index)
+            if first == address:
+                out.append(tx)
+        return out
+
+    async def get_inode_registration_outputs(self, address: str,
+                                             check_pending_txs: bool = False) -> List[Tuple[str, int]]:
+        rows = self.db.execute(
+            "SELECT tx_hash, idx FROM inode_registration_output WHERE address = ?",
+            (address,),
+        ).fetchall()
+        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
+        return [(r["tx_hash"], r["idx"]) for r in rows
+                if (r["tx_hash"], r["idx"]) not in pending]
+
+    # ----------------------------------------------------------- rebuild --
+
+    async def rebuild_utxos(self) -> None:
+        """Full-chain replay of every output table from the transactions log
+        (reference create_unspent_outputs.py + database.py:846-862) — the
+        consensus-bug detector: any divergence from live tables is a bug."""
+        for table in ("unspent_outputs",) + _GOV_TABLES:
+            self.db.execute(f"DELETE FROM {table}")
+        rows = self.db.execute(
+            "SELECT t.tx_hex FROM transactions t JOIN blocks b ON"
+            " b.hash = t.block_hash ORDER BY b.id"
+        ).fetchall()
+        txs = [tx_from_hex(r["tx_hex"], check_signatures=False) for r in rows]
+        for tx in txs:
+            await self.add_transaction_outputs([tx])
+            await self.remove_outputs([tx])
+        self.db.commit()
+
+    # ----------------------------------------------------------- emission --
+
+    def record_emission(self, block_no: int, details: dict) -> None:
+        """Per-block reward audit sidecar (reference emission_details.json)."""
+        if self.emission_path is None:
+            return
+        data = {}
+        if os.path.exists(self.emission_path):
+            with open(self.emission_path) as f:
+                data = json.load(f)
+        data[str(block_no)] = details
+        tmp = self.emission_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self.emission_path)

@@ -1,0 +1,4 @@
+"""Block + transaction validation with TPU-batched signature verify."""
+
+from .block import BlockManager, DOUBLE_SPEND_WHITELIST, MERKLE_EXCEPTION
+from .txverify import TxVerifier, run_sig_checks

@@ -1,0 +1,403 @@
+"""Transaction verification: DPoS rules on host, signatures batched on TPU.
+
+The reference validates each transaction serially — ~10 rule checks with
+live DB reads, then one fastecdsa verify per input (transaction.py:185-238,
+transaction_input.py:100-109).  Here the rule checks stay host-side (they
+are state lookups, not compute) but signature verification is *collected*
+per transaction or per block and dispatched to the batched P-256 kernel in
+one device call (crypto/p256.py) — the design SURVEY.md §2.3 calls for.
+
+Signature semantics replicated exactly, including the reference's quirk of
+accepting a signature over EITHER the raw signing bytes OR their ASCII-hex
+string (transaction_input.py:100-109 tries both), and the per-tx
+(pubkey, signature) dedup (transaction.py:148-163).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..core.codecs import OutputType, TransactionType, string_to_point
+from ..core.constants import SMALLEST
+from ..core.tx import CoinbaseTx, Tx
+from ..state.storage import ChainState, _INPUT_TABLE
+
+# The one grandfathered unstake tx exempt from the release-votes rule
+# (reference transaction.py:471-472).
+_UNSTAKE_EXCEPTION_HASHES = {
+    "8befeb253bc6eddd8501f5b27a02b195f5c06a51ccf788213cbedafe7cc49c53",
+}
+
+
+class SigCheck(Tuple):
+    """(digest_bytes, digest_hexform, (r, s), pubkey_point) — one deferred
+    signature check."""
+
+
+def _dedup_sig_checks(tx: Tx, voter: bool,
+                      address_of) -> Optional[List[tuple]]:
+    """Collect per-input signature checks with the reference's dedup.
+
+    Returns None if any input is unsigned or its key can't resolve.
+    ``address_of(tx_input)`` -> spending (or voter) address string.
+    """
+    signing_bytes = bytes.fromhex(tx.hex(False))
+    digest = hashlib.sha256(signing_bytes).digest()
+    digest_hexform = hashlib.sha256(tx.hex(False).encode()).digest()
+    checks, seen = [], set()
+    for tx_input in tx.inputs:
+        if tx_input.signature is None:
+            return None
+        address = address_of(tx_input)
+        if address is None:
+            return None
+        try:
+            pub = string_to_point(address)
+        except (ValueError, NotImplementedError):
+            return None
+        key = (pub, tx_input.signature)
+        if key in seen:
+            continue
+        seen.add(key)
+        checks.append((digest, digest_hexform, tx_input.signature, pub))
+    return checks
+
+
+def run_sig_checks(checks: Sequence[tuple], backend: str = "auto") -> List[bool]:
+    """Verify deferred checks in one (or two) batched device calls.
+
+    Pass 1 verifies against the raw-bytes digest; only failures re-try the
+    hex-string digest (the reference's or-fallback).  ``backend='host'``
+    uses the C++/pure-Python path for tiny batches.
+    """
+    if not checks:
+        return []
+    use_host = backend == "host" or (backend == "auto" and len(checks) < 8)
+    if use_host:
+        from .. import native
+        from ..core import curve
+
+        out = []
+        for digest, digest_hex, sig, pub in checks:
+            got = native.p256_verify(digest, sig[0], sig[1], pub[0], pub[1])
+            if got is None:
+                got = _host_verify_digest(digest, sig, pub)
+            if not got:
+                got2 = native.p256_verify(digest_hex, sig[0], sig[1], pub[0], pub[1])
+                if got2 is None:
+                    got2 = _host_verify_digest(digest_hex, sig, pub)
+                got = got2
+            out.append(bool(got))
+        return out
+
+    from ..crypto import p256
+
+    first = p256.verify_batch_prehashed(
+        [c[0] for c in checks], [c[2] for c in checks], [c[3] for c in checks])
+    out = list(map(bool, first))
+    retry = [i for i, ok in enumerate(out) if not ok]
+    if retry:
+        second = p256.verify_batch_prehashed(
+            [checks[i][1] for i in retry],
+            [checks[i][2] for i in retry],
+            [checks[i][3] for i in retry])
+        for i, ok in zip(retry, second):
+            out[i] = bool(ok)
+    return out
+
+
+def _host_verify_digest(digest: bytes, sig, pub) -> bool:
+    from ..core import curve
+    from ..core.constants import CURVE_N, CURVE_P
+
+    r, s = sig
+    if not (0 < r < CURVE_N and 0 < s < CURVE_N):
+        return False
+    z = int.from_bytes(digest, "big")
+    w = pow(s, -1, CURVE_N)
+    p1 = curve.point_mul(z * w % CURVE_N, curve.G)
+    p2 = curve.point_mul(r * w % CURVE_N, pub)
+    p = curve.point_add(p1, p2)
+    return p is not None and p[0] % CURVE_N == r % CURVE_N
+
+
+class TxVerifier:
+    """All rule checks for one transaction against a ChainState.
+
+    Mirrors Transaction.verify's chain (transaction.py:185-238); each rule
+    method cites its reference lines.
+    """
+
+    def __init__(self, state: ChainState, is_syncing: bool = False):
+        self.state = state
+        self.is_syncing = is_syncing
+
+    # -- address resolution ------------------------------------------------
+
+    async def input_address(self, tx_input) -> Optional[str]:
+        return await self.state.resolve_output_address(tx_input.tx_hash, tx_input.index)
+
+    async def voter_address(self, tx_input) -> Optional[str]:
+        """For revoke inputs: the vote tx's FIRST input address
+        (transaction_input.py:56-58, 79-82)."""
+        info = await self.state.get_transaction_info(tx_input.tx_hash)
+        if info is None or not info["inputs_addresses"]:
+            tx = await self.state.get_transaction(tx_input.tx_hash, include_pending=True)
+            if tx is None or tx.is_coinbase or not tx.inputs:
+                return None
+            return await self.input_address(tx.inputs[0])
+        return info["inputs_addresses"][0]
+
+    # -- double spends -----------------------------------------------------
+
+    @staticmethod
+    def no_internal_double_spend(tx: Tx) -> bool:
+        """No outpoint used twice within the tx (transaction.py:90-97)."""
+        outpoints = [i.outpoint for i in tx.inputs]
+        return len(set(outpoints)) == len(outpoints)
+
+    async def inputs_unspent(self, tx: Tx) -> bool:
+        """Every input exists in the UTXO-class table its tx type spends
+        (transaction.py:99-124)."""
+        table = _INPUT_TABLE.get(tx.transaction_type, "unspent_outputs")
+        present = await self.state.outpoints_exist(
+            [i.outpoint for i in tx.inputs], table)
+        return all(present)
+
+    async def no_pending_double_spend(self, tx: Tx) -> bool:
+        """Inputs absent from the pending-spent overlay (transaction.py:126-133)."""
+        pending = await self.state.get_pending_spent_outpoints()
+        return all(i.outpoint not in pending for i in tx.inputs)
+
+    # -- DPoS rules (each returns True when the rule does not apply) -------
+
+    async def check_stake(self, tx: Tx) -> bool:
+        """transaction.py:434-465."""
+        if not any(o.output_type == OutputType.STAKE for o in tx.outputs):
+            return True
+        address = await self.input_address(tx.inputs[0])
+        stakes = await self.state.get_stake_outputs(address)
+        if stakes and not self.is_syncing:
+            return False
+        pending = [
+            t for t in await self.state.get_pending_stake_transactions(address)
+            if t.hash() != tx.hash()
+        ]
+        if pending:
+            return False
+        delegate_power = sum(
+            o.amount for o in tx.outputs
+            if o.output_type == OutputType.DELEGATE_VOTING_POWER)
+        if delegate_power > 0:
+            if delegate_power != 10 * SMALLEST:  # 10 "coins" of voting power
+                return False
+            if await self.state.get_delegates_all_power(address):
+                return False
+        else:
+            if not await self.state.get_delegates_all_power(address):
+                return False
+        return True
+
+    async def check_unstake(self, tx: Tx) -> bool:
+        """transaction.py:467-479."""
+        if not any(o.output_type == OutputType.UN_STAKE for o in tx.outputs):
+            return True
+        address = await self.input_address(tx.inputs[0])
+        if await self.state.get_delegates_spent_votes(address) \
+                and tx.hash() not in _UNSTAKE_EXCEPTION_HASHES:
+            return False
+        if await self.state.get_pending_vote_as_delegate_transactions(address):
+            return False
+        return True
+
+    async def check_inode_register(self, tx: Tx) -> bool:
+        """transaction.py:325-362."""
+        if not any(o.output_type == OutputType.INODE_REGISTRATION for o in tx.outputs):
+            return True
+        address = await self.input_address(tx.inputs[0])
+        amount = sum(o.amount for o in tx.outputs
+                     if o.output_type == OutputType.INODE_REGISTRATION)
+        if amount != 1000 * SMALLEST:
+            return False
+        if not await self.state.get_stake_outputs(address):
+            return False
+        if await self.state.is_inode_registered(address, check_pending_txs=True):
+            return False
+        if await self.state.is_validator_registered(address, check_pending_txs=True):
+            return False
+        if len(await self.state.get_active_inodes(check_pending_txs=True)) >= 12:
+            return False
+        active = await self.state.get_active_inodes()
+        if any(e["wallet"] == address for e in active):
+            return False
+        return True
+
+    async def check_inode_deregister(self, tx: Tx) -> bool:
+        """transaction.py:240-254."""
+        if tx.transaction_type != TransactionType.INODE_DE_REGISTRATION:
+            return True
+        address = await self.input_address(tx.inputs[0])
+        if not await self.state.get_inode_registration_outputs(address):
+            return False
+        active = await self.state.get_active_inodes()
+        if any(e["wallet"] == address for e in active):
+            return False
+        return True
+
+    async def check_validator_register(self, tx: Tx) -> bool:
+        """transaction.py:364-396."""
+        if tx.transaction_type != TransactionType.VALIDATOR_REGISTRATION:
+            return True
+        address = await self.input_address(tx.inputs[0])
+        if not await self.state.get_stake_outputs(address):
+            return False
+        if await self.state.is_validator_registered(address, check_pending_txs=True):
+            return False
+        if await self.state.is_inode_registered(address, check_pending_txs=True):
+            return False
+        reg_amount = sum(o.amount for o in tx.outputs
+                         if o.output_type == OutputType.VALIDATOR_REGISTRATION)
+        if reg_amount != 100 * SMALLEST:
+            return False
+        power = [o for o in tx.outputs
+                 if o.output_type == OutputType.VALIDATOR_VOTING_POWER]
+        if len(power) != 1 or power[0].amount != 10 * SMALLEST:
+            return False
+        return True
+
+    async def check_vote_as_validator(self, tx: Tx) -> bool:
+        """transaction.py:256-288."""
+        if tx.transaction_type != TransactionType.VOTE_AS_VALIDATOR:
+            return True
+        vote_range = sum(o.amount for o in tx.outputs
+                         if o.output_type == OutputType.VOTE_AS_VALIDATOR)
+        if vote_range > 10 * SMALLEST or vote_range <= 0:
+            return False
+        address = await self.input_address(tx.inputs[0])
+        if await self.state.is_inode_registered(address, check_pending_txs=True):
+            return False
+        if not await self.state.is_validator_registered(address, check_pending_txs=True):
+            return False
+        recipient = ""
+        for o in tx.outputs:
+            if o.output_type == OutputType.VOTE_AS_VALIDATOR:
+                recipient = o.address
+        if not await self.state.is_inode_registered(recipient, check_pending_txs=True):
+            return False
+        return True
+
+    async def check_vote_as_delegate(self, tx: Tx,
+                                     verifying_add_pending: bool = False) -> bool:
+        """transaction.py:290-323."""
+        if tx.transaction_type != TransactionType.VOTE_AS_DELEGATE:
+            return True
+        vote_range = sum(o.amount for o in tx.outputs
+                         if o.output_type == OutputType.VOTE_AS_DELEGATE)
+        if vote_range > 10 * SMALLEST or vote_range <= 0:
+            return False
+        address = await self.input_address(tx.inputs[0])
+        if await self.state.is_inode_registered(address, check_pending_txs=True):
+            return False
+        if not await self.state.get_stake_outputs(
+                address, check_pending_txs=verifying_add_pending):
+            return False
+        recipient = ""
+        for o in tx.outputs:
+            if o.output_type == OutputType.VOTE_AS_DELEGATE:
+                recipient = o.address
+        if not await self.state.is_validator_registered(recipient, check_pending_txs=True):
+            return False
+        return True
+
+    async def check_revoke_as_validator(self, tx: Tx) -> bool:
+        """transaction.py:399-417."""
+        if tx.transaction_type != TransactionType.REVOKE_AS_VALIDATOR:
+            return True
+        address = await self.voter_address(tx.inputs[0])
+        if not await self.state.is_validator_registered(address, check_pending_txs=True):
+            return False
+        if not await self.state.get_stake_outputs(address):
+            return False
+        valid = [await self.state.is_revoke_valid(i.tx_hash) for i in tx.inputs]
+        return any(valid)
+
+    async def check_revoke_as_delegate(self, tx: Tx) -> bool:
+        """transaction.py:419-432."""
+        if tx.transaction_type != TransactionType.REVOKE_AS_DELEGATE:
+            return True
+        address = await self.voter_address(tx.inputs[0])
+        if not await self.state.get_stake_outputs(address):
+            return False
+        valid = [await self.state.is_revoke_valid(i.tx_hash) for i in tx.inputs]
+        return any(valid)
+
+    # -- outputs & fees ----------------------------------------------------
+
+    @staticmethod
+    def check_outputs(tx: Tx) -> bool:
+        """Non-empty, every output verifies (transaction.py:181-183)."""
+        return bool(tx.outputs) and all(o.verify() for o in tx.outputs)
+
+    async def check_fees(self, tx: Tx) -> bool:
+        """fee >= 0 (transaction.py:234-236, 499-518)."""
+        return await self.state.tx_fees(tx) >= 0
+
+    # -- the full chain ----------------------------------------------------
+
+    async def rules_ok(self, tx: Tx, check_double_spend: bool = True,
+                       verifying_add_pending: bool = False) -> bool:
+        """Everything except signatures, in reference order."""
+        if check_double_spend and not self.no_internal_double_spend(tx):
+            return False
+        if check_double_spend and not await self.inputs_unspent(tx):
+            return False
+        for rule in (
+            self.check_stake,
+            self.check_unstake,
+            self.check_validator_register,
+            self.check_revoke_as_validator,
+            self.check_revoke_as_delegate,
+            self.check_inode_deregister,
+            self.check_inode_register,
+            self.check_vote_as_validator,
+        ):
+            if not await rule(tx):
+                return False
+        if not await self.check_vote_as_delegate(
+                tx, verifying_add_pending=verifying_add_pending):
+            return False
+        if not self.check_outputs(tx):
+            return False
+        if not await self.check_fees(tx):
+            return False
+        return True
+
+    async def collect_sig_checks(self, tx: Tx) -> Optional[List[tuple]]:
+        """Deferred signature tuples for this tx (None -> invalid)."""
+        is_revoke = tx.transaction_type in (
+            TransactionType.REVOKE_AS_VALIDATOR, TransactionType.REVOKE_AS_DELEGATE)
+        addresses = {}
+        for tx_input in tx.inputs:
+            addr = (await self.voter_address(tx_input) if is_revoke
+                    else await self.input_address(tx_input))
+            addresses[tx_input.outpoint] = addr
+        return _dedup_sig_checks(
+            tx, is_revoke, lambda i: addresses.get(i.outpoint))
+
+    async def verify(self, tx: Tx, check_double_spend: bool = True,
+                     verifying_add_pending: bool = False,
+                     sig_backend: str = "auto") -> bool:
+        """Full single-tx verification (rules + signatures)."""
+        if not await self.rules_ok(tx, check_double_spend, verifying_add_pending):
+            return False
+        checks = await self.collect_sig_checks(tx)
+        if checks is None:
+            return False
+        return all(run_sig_checks(checks, backend=sig_backend))
+
+    async def verify_pending(self, tx: Tx, sig_backend: str = "auto") -> bool:
+        """add-pending intake check (transaction.py:481-482)."""
+        return (await self.verify(tx, verifying_add_pending=True,
+                                  sig_backend=sig_backend)
+                and await self.no_pending_double_spend(tx))
