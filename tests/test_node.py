@@ -1,0 +1,400 @@
+"""Node-layer integration tests: HTTP API, miner protocol, gossip, sync,
+WebSocket push — multiple in-process nodes over real localhost sockets.
+
+Each node gets an isolated in-memory ChainState; servers are aiohttp
+TestServers on ephemeral ports, so gossip/sync exercise the real HTTP
+plane (reference upow/node/main.py behaviors; SURVEY.md §4's "multi-node
+harness" gap).  No pytest-asyncio in this environment: every test runs
+its whole scenario inside one ``asyncio.run`` via :func:`run_cluster`.
+"""
+
+import asyncio
+import json
+from decimal import Decimal
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from upow_tpu.config import Config
+from upow_tpu.core import curve, point_to_string
+from upow_tpu.core.clock import timestamp
+from upow_tpu.core.header import BlockHeader
+from upow_tpu.core.merkle import miner_merkle_root
+from upow_tpu.mine.engine import MiningJob, mine
+from upow_tpu.node.app import GENESIS_PREV_HASH, Node
+from upow_tpu.wallet.builders import WalletBuilder
+
+
+@pytest.fixture(autouse=True)
+def easy_difficulty(monkeypatch):
+    from upow_tpu.core import clock, difficulty
+
+    monkeypatch.setattr(difficulty, "START_DIFFICULTY", Decimal("1.0"))
+    yield
+    clock.reset()
+
+
+@pytest.fixture
+def keys():
+    d, pub = curve.keygen(rng=4242)
+    d2, pub2 = curve.keygen(rng=4343)
+    return {"d": d, "addr": point_to_string(pub),
+            "d2": d2, "addr2": point_to_string(pub2)}
+
+
+def make_config(tmp_path, name: str) -> Config:
+    cfg = Config()
+    cfg.node.db_path = ""            # in-memory
+    cfg.node.seed_url = ""           # no external seed
+    cfg.node.peers_file = str(tmp_path / f"{name}_nodes.json")
+    cfg.node.ip_config_file = ""
+    cfg.ws.enabled = True
+    cfg.device.sig_backend = "host"
+    cfg.log.path = ""
+    cfg.log.console = False
+    return cfg
+
+
+class Cluster:
+    """In-process nodes behind real localhost HTTP servers."""
+
+    def __init__(self, tmp_path):
+        self.tmp_path = tmp_path
+        self.nodes = []
+        self.servers = []
+        self.clients = []
+
+    async def add_node(self, name: str) -> tuple:
+        node = Node(make_config(self.tmp_path, name))
+        server = TestServer(node.app)
+        await server.start_server()
+        client = TestClient(server)
+        node.self_url = f"http://127.0.0.1:{server.port}"
+        node.started = True  # skip first-request bootstrap
+        self.nodes.append(node)
+        self.servers.append(server)
+        self.clients.append(client)
+        return node, client
+
+    def url(self, i: int) -> str:
+        return f"http://127.0.0.1:{self.servers[i].port}"
+
+    async def close(self):
+        for node in self.nodes:
+            await node.close()
+        for client in self.clients:
+            await client.close()
+        for server in self.servers:
+            await server.close()
+
+
+def run_cluster(tmp_path, scenario):
+    """One event loop per test: build cluster, run scenario, tear down."""
+
+    async def main():
+        cluster = Cluster(tmp_path)
+        try:
+            await scenario(cluster)
+        finally:
+            await cluster.close()
+
+    asyncio.run(main())
+
+
+async def mine_via_api(client: TestClient, address: str) -> dict:
+    """Drive the miner protocol over HTTP: get_mining_info → search →
+    push_block (reference miner.py:126-156)."""
+    from upow_tpu.core import clock
+
+    clock.advance(1)  # satisfy strict timestamp monotonicity per block
+    resp = await client.get("/get_mining_info")
+    info = (await resp.json())["result"]
+    last_block = dict(info["last_block"])
+    prev_hash = last_block.get("hash", GENESIS_PREV_HASH)
+    pending_hashes = info["pending_transactions_hashes"]
+    header = BlockHeader(
+        previous_hash=prev_hash,
+        address=address,
+        merkle_root=miner_merkle_root(pending_hashes),
+        timestamp=timestamp(),
+        difficulty_x10=int(Decimal(str(info["difficulty"])) * 10),
+        nonce=0,
+    )
+    job = MiningJob(header.prefix_bytes(), prev_hash,
+                    Decimal(str(info["difficulty"])))
+    if last_block.get("hash"):
+        result = mine(job, "python", batch=1 << 14, ttl=300)
+        assert result.nonce is not None
+        header.nonce = result.nonce
+    resp = await client.post("/push_block", json={
+        "block_content": header.hex(),
+        "txs": pending_hashes,
+        "block_no": last_block.get("id", 0) + 1,
+    })
+    return await resp.json()
+
+
+# --------------------------------------------------------------- basics ----
+
+def test_root_and_supply(tmp_path, keys):
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        res = await (await client.get("/")).json()
+        assert res["ok"] and "unspent_outputs_hash" in res
+        res = await (await client.get("/get_supply_info")).json()
+        assert res["ok"] and res["result"]["max_supply"] == 18884643.75
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_mine_block_via_api(tmp_path, keys):
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        res = await mine_via_api(client, keys["addr"])
+        assert res == {"ok": True}
+        res = await (await client.get("/get_block",
+                                      params={"block": "1"})).json()
+        assert res["ok"]
+        assert res["result"]["block"]["address"] == keys["addr"]
+        res = await (await client.get(
+            "/get_address_info", params={"address": keys["addr"]})).json()
+        assert Decimal(res["result"]["balance"]) > 0
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_push_tx_and_mempool_flow(tmp_path, keys):
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        await mine_via_api(client, keys["addr"])
+        builder = WalletBuilder(node.state)
+        tx = await builder.create_transaction(keys["d"], keys["addr2"], "1.5")
+        res = await (await client.get("/push_tx",
+                                      params={"tx_hex": tx.hex()})).json()
+        assert res["ok"], res
+        assert res["tx_hash"] == tx.hash()
+        # duplicate rejected by the dedup cache
+        res = await (await client.get("/push_tx",
+                                      params={"tx_hex": tx.hex()})).json()
+        assert not res["ok"]
+        res = await (await client.get("/get_pending_transactions")).json()
+        assert tx.hex() in res["result"]
+        # mine it, then check balances and explorer views
+        res = await mine_via_api(client, keys["addr"])
+        assert res == {"ok": True}
+        res = await (await client.get(
+            "/get_address_info", params={"address": keys["addr2"]})).json()
+        assert Decimal(res["result"]["balance"]) == Decimal("1.5")
+        res = await (await client.get(
+            "/get_transaction", params={"tx_hash": tx.hash()})).json()
+        assert res["ok"] and res["result"]["is_confirm"] is True
+        assert res["result"]["outputs"][0]["amount"] == 1.5
+        res = await (await client.get(
+            "/get_address_transactions",
+            params={"address": keys["addr2"], "limit": "10"})).json()
+        assert any(t["hash"] == tx.hash()
+                   for t in res["result"]["transactions"])
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_block_endpoints(tmp_path, keys):
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        await mine_via_api(client, keys["addr"])
+        await mine_via_api(client, keys["addr"])
+        res = await (await client.get(
+            "/get_blocks", params={"offset": "1", "limit": "10"})).json()
+        assert len(res["result"]) == 2
+        assert res["result"][0]["block"]["id"] == 1
+        res = await (await client.get(
+            "/get_block_details", params={"block": "2"})).json()
+        assert res["ok"] and len(res["result"]["transactions"]) == 1
+        res = await (await client.get(
+            "/get_block", params={"block": "aa" * 32})).json()
+        assert not res["ok"]
+
+    run_cluster(tmp_path, scenario)
+
+
+# --------------------------------------------------------------- gossip ----
+
+def test_gossip_block_propagation(tmp_path, keys):
+    async def scenario(cluster):
+        node_a, client_a = await cluster.add_node("a")
+        node_b, client_b = await cluster.add_node("b")
+        node_a.peers.add(cluster.url(1))
+        res = await mine_via_api(client_a, keys["addr"])
+        assert res == {"ok": True}
+        for _ in range(100):
+            if await node_b.state.get_next_block_id() == 2:
+                break
+            await asyncio.sleep(0.1)
+        assert await node_b.state.get_next_block_id() == 2
+        assert (await node_a.state.get_unspent_outputs_hash()
+                == await node_b.state.get_unspent_outputs_hash())
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_add_node_and_get_nodes(tmp_path, keys):
+    async def scenario(cluster):
+        node_a, client_a = await cluster.add_node("a")
+        node_b, client_b = await cluster.add_node("b")
+        res = await (await client_a.get(
+            "/add_node", params={"url": cluster.url(1)})).json()
+        assert res["ok"], res
+        res = await (await client_a.get("/get_nodes")).json()
+        assert cluster.url(1) in res["result"]
+        res = await (await client_a.get(
+            "/add_node", params={"url": cluster.url(1)})).json()
+        assert not res["ok"]
+
+    run_cluster(tmp_path, scenario)
+
+
+# ----------------------------------------------------------------- sync ----
+
+def test_sync_from_scratch(tmp_path, keys):
+    async def scenario(cluster):
+        node_a, client_a = await cluster.add_node("a")
+        node_b, client_b = await cluster.add_node("b")
+        for _ in range(3):
+            assert (await mine_via_api(client_a, keys["addr"]))["ok"]
+        res = await (await client_b.get(
+            "/sync_blockchain", params={"node_url": cluster.url(0)})).json()
+        assert res["ok"], res
+        assert await node_b.state.get_next_block_id() == 4
+        assert (await node_a.state.get_unspent_outputs_hash()
+                == await node_b.state.get_unspent_outputs_hash())
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_sync_with_transactions(tmp_path, keys):
+    async def scenario(cluster):
+        node_a, client_a = await cluster.add_node("a")
+        node_b, client_b = await cluster.add_node("b")
+        await mine_via_api(client_a, keys["addr"])
+        builder = WalletBuilder(node_a.state)
+        tx = await builder.create_transaction(keys["d"], keys["addr2"], "2")
+        await node_a.state.add_pending_transaction(tx)
+        await mine_via_api(client_a, keys["addr"])
+        res = await (await client_b.get(
+            "/sync_blockchain", params={"node_url": cluster.url(0)})).json()
+        assert res["ok"], res
+        assert (await node_b.state.get_address_balance(keys["addr2"])) == 2 * 10**8
+        assert (await node_a.state.get_unspent_outputs_hash()
+                == await node_b.state.get_unspent_outputs_hash())
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_fork_reorg_convergence(tmp_path, keys):
+    """Partition: A and B mine divergent chains; B (shorter) syncs from A
+    and reorgs onto A's chain (main.py:167-185's common-ancestor walk)."""
+
+    async def scenario(cluster):
+        node_a, client_a = await cluster.add_node("a")
+        node_b, client_b = await cluster.add_node("b")
+        # fork detection only engages past the reorg window (the reference
+        # hardcodes id > 500, main.py:167; shrink the window to keep the
+        # test chain short)
+        node_a.config.node.sync_reorg_window = 4
+        node_b.config.node.sync_reorg_window = 4
+        for _ in range(5):  # common prefix longer than the window
+            assert (await mine_via_api(client_a, keys["addr"]))["ok"]
+        res = await (await client_b.get(
+            "/sync_blockchain", params={"node_url": cluster.url(0)})).json()
+        assert res["ok"], res
+        # partition: A mines 2 more, B mines 1 (same genesis-key address —
+        # the emission gate, manager.py:679-689 — but later timestamp, so
+        # the chains fork)
+        assert (await mine_via_api(client_a, keys["addr"]))["ok"]
+        assert (await mine_via_api(client_a, keys["addr"]))["ok"]
+        assert (await mine_via_api(client_b, keys["addr"]))["ok"]
+        assert await node_a.state.get_next_block_id() == 8
+        assert await node_b.state.get_next_block_id() == 7
+        a_tip = (await node_a.state.get_last_block())["hash"]
+        b_tip = (await node_b.state.get_last_block())["hash"]
+        assert a_tip != b_tip  # genuinely diverged
+        res = await (await client_b.get(
+            "/sync_blockchain", params={"node_url": cluster.url(0)})).json()
+        assert res["ok"], res
+        assert await node_b.state.get_next_block_id() == 8
+        assert (await node_b.state.get_last_block())["hash"] == a_tip
+        assert (await node_a.state.get_unspent_outputs_hash()
+                == await node_b.state.get_unspent_outputs_hash())
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_push_block_gap_triggers_sync(tmp_path, keys):
+    """A node receiving a too-new block with a Sender-Node header syncs
+    from that sender (main.py:566-577)."""
+
+    async def scenario(cluster):
+        node_a, client_a = await cluster.add_node("a")
+        node_b, client_b = await cluster.add_node("b")
+        for _ in range(3):
+            assert (await mine_via_api(client_a, keys["addr"]))["ok"]
+        tip = await (await client_a.get(
+            "/get_block", params={"block": "3"})).json()
+        res = await (await client_b.post(
+            "/push_block",
+            json={"block_content": tip["result"]["block"]["content"],
+                  "txs": [], "block_no": 3},
+            headers={"Sender-Node": cluster.url(0)})).json()
+        assert not res["ok"] and "sync" in res["error"]
+        for _ in range(100):
+            if await node_b.state.get_next_block_id() == 4:
+                break
+            await asyncio.sleep(0.1)
+        assert await node_b.state.get_next_block_id() == 4
+
+    run_cluster(tmp_path, scenario)
+
+
+# ------------------------------------------------------------- websocket ---
+
+def test_ws_new_block_broadcast(tmp_path, keys):
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        ws = await client.ws_connect("/ws")
+        hello = json.loads((await ws.receive()).data)
+        assert hello["type"] == "connection_established"
+        await ws.send_str(json.dumps({"type": "subscribe_block"}))
+        sub = json.loads((await ws.receive()).data)
+        assert sub["type"] == "success"
+        assert (await mine_via_api(client, keys["addr"]))["ok"]
+        msg = json.loads((await asyncio.wait_for(ws.receive(), 10)).data)
+        assert msg["type"] == "new_block"
+        assert msg["data"]["block_no"] == 1
+        await ws.send_str(json.dumps({"type": "ping"}))
+        assert json.loads((await ws.receive()).data)["type"] == "pong"
+        await ws.send_str(json.dumps({"type": "bogus"}))
+        assert json.loads((await ws.receive()).data)["type"] == "error"
+        await ws.close()
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_ws_transaction_broadcast(tmp_path, keys):
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        await mine_via_api(client, keys["addr"])
+        ws = await client.ws_connect("/ws")
+        await ws.receive()  # connection_established
+        await ws.send_str(json.dumps({"type": "subscribe_transaction"}))
+        await ws.receive()  # success
+        builder = WalletBuilder(node.state)
+        tx = await builder.create_transaction(keys["d"], keys["addr2"], "1")
+        res = await (await client.get("/push_tx",
+                                      params={"tx_hex": tx.hex()})).json()
+        assert res["ok"]
+        msg = json.loads((await asyncio.wait_for(ws.receive(), 10)).data)
+        assert msg["type"] == "new_transaction"
+        assert msg["data"]["tx_hash"] == tx.hash()
+        await ws.close()
+
+    run_cluster(tmp_path, scenario)

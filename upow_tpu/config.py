@@ -1,0 +1,155 @@
+"""Typed configuration for every process in the framework.
+
+The reference scatters its knobs across ``config.py:1`` (the CORE_URL
+seed), env vars (``upow/node/main.py:249-254``), ``ip_config.json``
+(hot-reloaded, ``ip_manager.py:19-40``), WebSocket constants
+(``websocket/socket_config.py:6-43``) and hardcoded consensus constants.
+Here one dataclass tree feeds the node, miner, wallet and bench; every
+field can come from (in order of precedence) explicit kwargs, a JSON
+config file, or ``UPOW_``-prefixed environment variables.
+
+Device selection (the ``device: cpu|tpu`` switch from BASELINE.json) maps
+to the mining/verify backend choices; mesh shape covers multi-chip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+DEFAULT_SEED_URL = "https://api.upow.ai/"
+
+
+@dataclass
+class DeviceConfig:
+    """Compute-backend selection (BASELINE.json `device` flag)."""
+
+    device: str = "auto"            # auto | tpu | cpu
+    search_backend: str = "auto"    # auto | pallas | jnp | native | python
+    sig_backend: str = "auto"       # auto | tpu | host
+    search_batch: int = 1 << 24     # nonces per device dispatch
+    verify_pad_block: int = 128     # lane padding for the P-256 kernel
+    mesh_devices: int = 0           # 0 = all visible devices
+
+    def resolve_search_backend(self, platform: str) -> str:
+        if self.search_backend != "auto":
+            return self.search_backend
+        return "pallas" if platform == "tpu" else "jnp"
+
+
+@dataclass
+class NodeConfig:
+    host: str = "0.0.0.0"
+    port: int = 3006                # reference run_node.py port
+    db_path: str = "upow_tpu.db"    # sqlite file ('' -> in-memory)
+    seed_url: str = DEFAULT_SEED_URL
+    peers_file: str = "nodes.json"
+    ip_config_file: str = "ip_config.json"
+    self_url: str = ""              # discovered from first request if empty
+    max_peers: int = 100            # nodes_manager.py:26
+    active_within: int = 7 * 86400  # peer considered active (nodes_manager.py:24)
+    prune_after: int = 90 * 86400   # forget peers silent this long (:25)
+    propagate_sample: int = 10      # sample size per class (:144-149)
+    response_cap: int = 20 * 1024 * 1024  # streaming response cap (:79-86)
+    sync_reorg_window: int = 500    # main.py:167-185
+    sync_page: int = 1000           # block download page (main.py:188-192)
+    mempool_clean_interval: int = 600  # main.py:678-683
+
+
+@dataclass
+class WsConfig:
+    """WebSocket push sidecar limits (websocket/socket_config.py:6-43)."""
+
+    enabled: bool = True
+    max_connections: int = 1000
+    max_per_user: int = 5
+    max_message_bytes: int = 64 * 1024
+    rate_limit_per_minute: int = 60
+    heartbeat_interval: float = 30.0
+    connection_expiry: float = 300.0
+    channels: tuple = ("block", "transaction")
+
+
+@dataclass
+class MinerConfig:
+    address: str = ""
+    node_url: str = DEFAULT_SEED_URL
+    workers: int = 1                # device shards, not processes
+    ttl: float = 90.0               # per-template budget (miner.py:96-98)
+    refresh: float = 100.0          # outer watchdog (miner.py:149-156)
+
+
+@dataclass
+class LogConfig:
+    path: str = "logs/app.log"
+    level: str = "INFO"
+    max_bytes: int = 5 * 1024 * 1024   # my_logger.py rotation size
+    backups: int = 100
+    console: bool = True
+
+
+@dataclass
+class Config:
+    device: DeviceConfig = field(default_factory=DeviceConfig)
+    node: NodeConfig = field(default_factory=NodeConfig)
+    ws: WsConfig = field(default_factory=WsConfig)
+    miner: MinerConfig = field(default_factory=MinerConfig)
+    log: LogConfig = field(default_factory=LogConfig)
+
+    @classmethod
+    def load(cls, path: Optional[str] = None, **overrides) -> "Config":
+        """File -> env -> kwargs, later wins.
+
+        Env vars: ``UPOW_<SECTION>_<FIELD>`` (e.g. ``UPOW_NODE_PORT=3007``,
+        ``UPOW_DEVICE_DEVICE=tpu``).  ``overrides`` are dotted
+        (``node__port=3007``).
+        """
+        cfg = cls()
+        if path and os.path.exists(path):
+            with open(path) as f:
+                cfg = _merge_dict(cfg, json.load(f))
+        cfg = _merge_env(cfg)
+        for key, value in overrides.items():
+            section, _, fname = key.partition("__")
+            sub = getattr(cfg, section)
+            if not hasattr(sub, fname):
+                raise KeyError(f"unknown config field {key}")
+            setattr(sub, fname, value)
+        return cfg
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _merge_dict(cfg: Config, data: dict) -> Config:
+    for section, values in data.items():
+        if not hasattr(cfg, section):
+            raise KeyError(f"unknown config section {section}")
+        sub = getattr(cfg, section)
+        for fname, value in values.items():
+            if not hasattr(sub, fname):
+                raise KeyError(f"unknown config field {section}.{fname}")
+            setattr(sub, fname, value)
+    return cfg
+
+
+def _merge_env(cfg: Config) -> Config:
+    for section in ("device", "node", "ws", "miner", "log"):
+        sub = getattr(cfg, section)
+        for f in dataclasses.fields(sub):
+            env = f"UPOW_{section.upper()}_{f.name.upper()}"
+            if env in os.environ:
+                raw = os.environ[env]
+                if f.type in ("int", int):
+                    value = int(raw)
+                elif f.type in ("float", float):
+                    value = float(raw)
+                elif f.type in ("bool", bool):
+                    value = raw.lower() in ("1", "true", "yes")
+                else:
+                    value = raw
+                setattr(sub, f.name, value)
+    return cfg

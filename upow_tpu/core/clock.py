@@ -1,10 +1,29 @@
-"""UTC epoch timestamp, matching the reference's helpers.py:37-38."""
+"""UTC epoch timestamp, matching the reference's helpers.py:37-38.
+
+A process-wide injectable offset supports tests that must cross protocol
+time windows (the 48-hour revoke rule, peer pruning) without sleeping —
+every consensus-path caller imports :func:`timestamp` from here, so the
+whole node moves through time together.
+"""
 
 from __future__ import annotations
 
 import time
 
+_offset = 0
+
 
 def timestamp() -> int:
-    """Whole seconds since the epoch, UTC."""
-    return int(time.time())
+    """Whole seconds since the epoch, UTC (+ any injected test offset)."""
+    return int(time.time()) + _offset
+
+
+def advance(seconds: int) -> None:
+    """Shift the process clock forward (tests only)."""
+    global _offset
+    _offset += int(seconds)
+
+
+def reset() -> None:
+    global _offset
+    _offset = 0

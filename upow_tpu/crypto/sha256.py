@@ -122,7 +122,10 @@ def make_template(prefix: bytes) -> SearchTemplate:
     """
     total_len = len(prefix) + 4
     n_full = len(prefix) // 64
-    if total_len - n_full * 64 > 56:  # nonce/padding must fit one tail block
+    # in-block message (rem + nonce) must leave room for 0x80 AND the
+    # 8-byte length field: rem + 4 + 1 <= 56, i.e. in-block total <= 55
+    # (at exactly 56 the 0x80 would be overwritten by the length field)
+    if total_len - n_full * 64 > 55:
         raise ValueError("tail would span two blocks — unsupported header size")
     state = tuple(int(x) for x in _H0)
     for i in range(n_full):

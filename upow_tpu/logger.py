@@ -1,0 +1,47 @@
+"""Logger setup: rotating file + console (reference upow/my_logger.py:17-53).
+
+One process-wide configuration on the ``upow_tpu`` logger namespace;
+every module logs via ``logging.getLogger("upow_tpu.<mod>")``.  The
+reference's ``--nologs`` flag (helpers.py:20) maps to ``console=False`` /
+a WARNING level.
+"""
+
+from __future__ import annotations
+
+import logging
+import logging.handlers
+import os
+from typing import Optional
+
+from .config import LogConfig
+
+_configured = False
+
+
+def setup_logging(cfg: Optional[LogConfig] = None) -> logging.Logger:
+    """Idempotent: first caller wins, later calls return the root logger."""
+    global _configured
+    root = logging.getLogger("upow_tpu")
+    if _configured:
+        return root
+    cfg = cfg or LogConfig()
+    root.setLevel(getattr(logging, cfg.level.upper(), logging.INFO))
+    fmt = logging.Formatter(
+        "%(asctime)s %(levelname)s [%(name)s] %(message)s")
+    if cfg.path:
+        os.makedirs(os.path.dirname(cfg.path) or ".", exist_ok=True)
+        fh = logging.handlers.RotatingFileHandler(
+            cfg.path, maxBytes=cfg.max_bytes, backupCount=cfg.backups)
+        fh.setFormatter(fmt)
+        root.addHandler(fh)
+    if cfg.console:
+        ch = logging.StreamHandler()
+        ch.setFormatter(fmt)
+        root.addHandler(ch)
+    root.propagate = False
+    _configured = True
+    return root
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    return logging.getLogger(f"upow_tpu.{name}" if name else "upow_tpu")

@@ -27,6 +27,12 @@ from ..core.header import BlockHeader
 from ..crypto import sha256 as sha_kernel
 
 NONCE_SPACE = 1 << 32
+# Device searchers reserve 0xFFFFFFFF as the no-hit sentinel of their
+# min-reduction (crypto/sha256.py SENTINEL), so that one nonce is never
+# searched: a hit there would be reported as a miss.  Excluding a single
+# candidate out of 2^32 costs ~nothing and keeps every backend's contract
+# identical.
+MAX_SEARCH_END = NONCE_SPACE - 1
 
 
 @dataclass
@@ -119,6 +125,7 @@ def mine(job: MiningJob, backend: str = "jnp", *, start: int = 0,
     without the per-nonce interleave that would defeat batching).
     """
     search = _make_searcher(job, backend)
+    stride_end = min(stride_end, MAX_SEARCH_END)
     t0 = time.time()
     tried = 0
     cursor = start

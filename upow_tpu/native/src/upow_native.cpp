@@ -101,6 +101,9 @@ extern "C" uint32_t upow_pow_search(const uint8_t* prefix, size_t prefix_len,
   for (size_t i = 0; i < n_full; i++) sha256::compress(mid, prefix + 64 * i);
   size_t rem = prefix_len - 64 * n_full;
   size_t total = prefix_len + 4;
+  // same bound as make_template: rem + nonce(4) + 0x80 must fit before the
+  // 8-byte length field (rem + 4 <= 55), else the tail spans two blocks
+  if (rem + 4 > 55) return 0xFFFFFFFFu;
 
   uint8_t tail[64] = {0};
   memcpy(tail, prefix + 64 * n_full, rem);

@@ -1,0 +1,4 @@
+"""Node: HTTP API, P2P gossip, chain sync (reference upow/node/)."""
+
+from .app import Node, run  # noqa: F401
+from .peers import NodeInterface, PeerBook  # noqa: F401

@@ -1,0 +1,875 @@
+"""The node: HTTP API + P2P gossip + chain sync (reference upow/node/main.py).
+
+aiohttp implementation of the full 20-endpoint surface, the gossip
+``propagate`` fan-out, the Sender-Node peer-learning middleware, tx intake
+with a 100-entry dedup cache, push_block with sync-on-gap triggers, and
+``sync_blockchain`` with the 500-block reorg window — all against one
+:class:`~upow_tpu.state.storage.ChainState` + :class:`BlockManager`.
+
+Request/response wire shapes match the reference endpoint-for-endpoint
+(main.py:461-1102): every handler returns the ``{"ok": bool, ...}``
+envelope, accepts both GET query params and POST JSON bodies where the
+reference does, and reads/sets the ``Sender-Node`` header.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import random
+import re
+import time
+from collections import deque
+from decimal import Decimal
+from typing import List, Optional
+
+from aiohttp import web
+
+from ..config import Config
+from ..core.constants import ENDIAN, MAX_SUPPLY, SMALLEST, VERSION
+from ..core.clock import timestamp
+from ..core.rewards import get_circulating_supply
+from ..core.header import block_to_bytes, split_block_content
+from ..core.merkle import merkle_root
+from ..core.tx import CoinbaseTx, Tx, tx_from_hex
+from ..logger import get_logger, setup_logging
+from ..state.storage import ChainState
+from ..verify.block import BlockManager
+from .ipfilter import IpFilter, is_local_ip
+from .peers import NodeInterface, PeerBook, _normalize
+
+log = get_logger("node")
+
+GENESIS_PREV_HASH = (18_884_643).to_bytes(32, ENDIAN).hex()
+
+# the one banned address (main.py:426-430)
+_BANNED_ADDRESSES = {"DgQKikeDqS2Fzue23KuA36L4eJSFh649zA9jJ6zwbzUMp"}
+
+
+def _fmt_amount(smallest_units: int) -> str:
+    return "{:f}".format(Decimal(smallest_units) / SMALLEST)
+
+
+class Node:
+    """One node instance: state + manager + peers + HTTP app.
+
+    In-process instantiable (the multi-node integration harness runs
+    several against isolated sqlite files and wires their HTTP apps
+    together via aiohttp's test utilities).
+    """
+
+    def __init__(self, config: Optional[Config] = None):
+        self.config = config or Config()
+        setup_logging(self.config.log)
+        self.state = ChainState(self.config.node.db_path or None)
+        self.manager = BlockManager(
+            self.state, sig_backend=self.config.device.sig_backend)
+        self.peers = PeerBook(self.config.node)
+        self.ip_filter = IpFilter(self.config.node.ip_config_file)
+        self.is_syncing = False
+        self.started = False
+        self.self_url = self.config.node.self_url
+        self.tx_cache: deque = deque(maxlen=100)
+        self._last_mempool_clean = 0
+        self._background: set = set()
+        self.ws_hub = None  # set by ws.attach(...) when enabled
+        self.app = self._build_app()
+
+    # ----------------------------------------------------------- plumbing --
+    def _spawn(self, coro) -> None:
+        """Fire-and-forget background task (FastAPI BackgroundTasks role)."""
+        task = asyncio.ensure_future(coro)
+        self._background.add(task)
+        task.add_done_callback(self._background.discard)
+
+    async def close(self) -> None:
+        for task in list(self._background):
+            task.cancel()
+        self.state.close()
+
+    @staticmethod
+    def _client_ip(request: web.Request) -> str:
+        xff = request.headers.get("x-forwarded-for", "")
+        if xff:
+            return xff.split(",")[0].strip()
+        real = request.headers.get("x-real-ip")
+        if real:
+            return real
+        peername = request.transport.get_extra_info("peername") if request.transport else None
+        return peername[0] if peername else ""
+
+    async def _params(self, request: web.Request) -> dict:
+        """Merge query params with a JSON body (reference Body(False))."""
+        params = dict(request.rel_url.query)
+        if request.method == "POST" and request.can_read_body:
+            try:
+                body = await request.json()
+                if isinstance(body, dict):
+                    params.update(body)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                pass
+        return params
+
+    # ----------------------------------------------------------- gossip ---
+    async def propagate(self, path: str, args: dict,
+                        ignore_url: Optional[str] = None,
+                        nodes: Optional[List[str]] = None) -> None:
+        """Fan-out to the propagate set (main.py:79-94)."""
+        self_base = _normalize(self.self_url)
+        ignore_base = _normalize(ignore_url or "")
+        aws = []
+        ifaces = []
+        for node_url in nodes if nodes is not None else self.peers.propagate_nodes():
+            iface = NodeInterface(node_url, self.config.node)
+            if iface.base_url in (self_base, ignore_base):
+                continue
+            aws.append(iface.request(path, args, self_base))
+            ifaces.append(iface)
+        for resp in await asyncio.gather(*aws, return_exceptions=True):
+            if isinstance(resp, Exception):
+                log.debug("propagate error: %s", resp)
+        for iface in ifaces:
+            await iface.close()
+
+    async def _propagate_old_transactions(self) -> None:
+        txs = await self.state.get_need_propagate_transactions()
+        for tx_hex in txs:
+            tx_hash = hashlib.sha256(bytes.fromhex(tx_hex)).hexdigest()
+            await self.state.update_pending_transaction_propagation(tx_hash)
+            await self.propagate("push_tx", {"tx_hex": tx_hex})
+
+    # -------------------------------------------------------- middleware --
+    @web.middleware
+    async def _middleware(self, request: web.Request, handler):
+        client_ip = self._client_ip(request)
+        if not self.ip_filter.allowed(client_ip):
+            return web.json_response(
+                {"ok": False, "error": "Access forbidden."}, status=403)
+        normalized = re.sub("/+", "/", request.path) or "/"
+        if normalized != request.path:
+            raise web.HTTPFound(normalized)
+        if normalized != "/" and not self.ip_filter.allowed(
+                client_ip, endpoint=normalized):
+            return web.json_response(
+                {"ok": False, "error": "Access forbidden temporarily."},
+                status=403)
+
+        sender = request.headers.get("Sender-Node")
+        if sender:
+            self.peers.add(sender)
+
+        host = request.host.split(":")[0] if request.host else ""
+        if normalized == "/send_to_address" and not (
+                is_local_ip(host) or host == "localhost"):
+            return web.json_response(
+                {"ok": False, "error": "Access forbidden. This endpoint can "
+                 "only be accessed from localhost."}, status=403)
+
+        # first-request bootstrap: learn peers-of-peers, discover self URL,
+        # announce ourselves (main.py:324-361)
+        if normalized != "/get_nodes" and not self.started and \
+                not (is_local_ip(host) or host == "localhost"):
+            self.started = True
+            if not self.self_url:
+                self.self_url = f"{request.scheme}://{request.host}"
+            self._spawn(self._bootstrap())
+
+        try:
+            response = await handler(request)
+        except web.HTTPException:
+            raise
+        except Exception as e:  # exception envelope (main.py:394-406)
+            log.error("Error on %s, %s: %s", request.path, type(e).__name__, e)
+            return web.json_response(
+                {"ok": False, "error": f"Uncaught {type(e).__name__} exception"},
+                status=500)
+        response.headers["Access-Control-Allow-Origin"] = "*"
+        self._spawn(self._propagate_old_transactions())
+        return response
+
+    async def _bootstrap(self) -> None:
+        try:
+            seeds = self.peers.recent_nodes()
+            if not seeds:
+                return
+            iface = NodeInterface(seeds[0], self.config.node)
+            try:
+                for url in await iface.get_nodes():
+                    self.peers.add(url)
+            finally:
+                await iface.close()
+            self.peers.remove(self.self_url)
+            await self.propagate("add_node", {"url": self.self_url})
+        except Exception as e:
+            log.debug("bootstrap failed: %s", e)
+
+    # ------------------------------------------------------- tx intake ----
+    async def _verify_and_push_tx(self, tx: Tx,
+                                  sender: Optional[str]) -> dict:
+        tx_hash = tx.hash()
+        if tx_hash in self.tx_cache:
+            return {"ok": False, "error": "Transaction just added"}
+        first_address = None
+        if tx.inputs:
+            first_address = await self.state.resolve_output_address(
+                tx.inputs[0].tx_hash, tx.inputs[0].index)
+        if first_address in _BANNED_ADDRESSES:
+            return {"ok": False, "error": "Access forbidden temporarily."}
+        if await self.state.pending_transaction_exists(tx_hash):
+            return {"ok": False, "error": "Transaction already present"}
+        try:
+            await self.state.add_pending_transaction(tx)
+        except Exception as e:
+            log.info("tx rejected %s: %s", tx_hash, e)
+            return {"ok": False, "error": "Transaction has not been added"}
+        if sender:
+            self.peers.update_last_message(sender)
+        self._spawn(self.propagate("push_tx", {"tx_hex": tx.hex()}))
+        if self.ws_hub is not None:
+            amount = sum(o.amount for o in tx.outputs)
+            self._spawn(self.ws_hub.broadcast_new_transaction({
+                "tx_hash": tx_hash,
+                "from": first_address,
+                "to": [o.address for o in tx.outputs],
+                "amount": _fmt_amount(amount),
+                "fees": _fmt_amount(await self.state.tx_fees(tx)),
+            }))
+        self.tx_cache.append(tx_hash)
+        log.info("Transaction has been accepted: %s", tx_hash)
+        return {"ok": True, "result": "Transaction has been accepted",
+                "tx_hash": tx_hash}
+
+    # ------------------------------------------------------- mining info --
+    async def _mining_info_result(self) -> dict:
+        self.manager.invalidate_difficulty()
+        difficulty, last_block = await self.manager.get_difficulty()
+        pending = sorted(await self.state.get_pending_transactions_limit(
+            hex_only=True))
+        if self._last_mempool_clean < timestamp() - self.config.node.mempool_clean_interval:
+            self._last_mempool_clean = timestamp()
+            self._spawn(self.manager.clear_pending_transactions())
+        return {
+            "difficulty": float(difficulty),
+            "last_block": _json_block(last_block),
+            "pending_transactions": pending[:10],
+            "pending_transactions_hashes": [
+                hashlib.sha256(bytes.fromhex(t)).hexdigest() for t in pending],
+            "merkle_root": merkle_root(
+                [tx_from_hex(t, check_signatures=False) for t in pending[:10]]),
+        }
+
+    # --------------------------------------------------------- handlers ---
+    async def h_root(self, request: web.Request) -> web.Response:
+        fingerprint = await self.state.get_unspent_outputs_hash()
+        return web.json_response({
+            "ok": True, "version": VERSION,
+            "unspent_outputs_hash": fingerprint,
+        })
+
+    async def h_push_tx(self, request: web.Request) -> web.Response:
+        if self.is_syncing:
+            return web.json_response(
+                {"ok": False, "error": "Node is already syncing"})
+        params = await self._params(request)
+        tx_hex = params.get("tx_hex")
+        if not tx_hex:
+            return web.json_response(
+                {"ok": False, "error": "Missing tx_hex"}, status=422)
+        try:
+            tx = await self._parse_tx(tx_hex)
+        except Exception as e:
+            return web.json_response(
+                {"ok": False, "error": f"Invalid transaction: {e}"})
+        result = await self._verify_and_push_tx(
+            tx, request.headers.get("Sender-Node"))
+        return web.json_response(result)
+
+    async def _parse_tx(self, tx_hex: str):
+        """Decode with the ambiguous-signature relink resolved against state
+        (core/tx.py tx_from_hex needs a sync resolver; pre-fetch the input
+        addresses with a first signature-free parse)."""
+        tx = tx_from_hex(tx_hex, check_signatures=False)
+        if tx.is_coinbase:
+            return tx
+        addrs = {}
+        for i in tx.inputs:
+            addrs[(i.tx_hash, i.index)] = await self.state.resolve_output_address(
+                i.tx_hash, i.index)
+        return tx_from_hex(
+            tx_hex, check_signatures=True,
+            resolve_address=lambda h, idx: addrs.get((h, idx)))
+
+    async def h_push_block(self, request: web.Request) -> web.Response:
+        if self.is_syncing:
+            return web.json_response(
+                {"ok": False, "error": "Node is already syncing"})
+        params = await self._params(request)
+        if "id" in params:
+            return web.json_response({"ok": False, "error": "Deprecated"})
+        block_content = params.get("block_content", "")
+        txs = params.get("txs", "")
+        block_no = params.get("block_no")
+        sender = request.headers.get("Sender-Node")
+        if isinstance(txs, str):
+            txs = txs.split(",")
+            if txs == [""]:
+                txs = []
+        try:
+            previous_hash = split_block_content(block_content)[0]
+        except Exception as e:
+            return web.json_response(
+                {"ok": False, "error": f"malformed block content: {e}"})
+        next_block_id = await self.state.get_next_block_id()
+        if block_no is None:
+            previous_block = await self.state.get_block(previous_hash)
+            if previous_block is None:
+                if sender:
+                    self._spawn(self.sync_blockchain(sender))
+                    return web.json_response({
+                        "ok": False,
+                        "error": "Previous hash not found, had to sync "
+                                 "according to sender node, block may have "
+                                 "been accepted"})
+                return web.json_response(
+                    {"ok": False, "error": "Previous hash not found"})
+            block_no = previous_block["id"] + 1
+        else:
+            block_no = int(block_no)
+        if next_block_id < block_no:
+            self._spawn(self.sync_blockchain(sender))
+            return web.json_response({
+                "ok": False,
+                "error": "Blocks missing, had to sync according to sender "
+                         "node, block may have been accepted"})
+        if next_block_id > block_no:
+            return web.json_response({"ok": False, "error": "Too old block"})
+
+        final_transactions: List[Tx] = []
+        hashes: List[str] = []
+        for tx_hex in txs:
+            if len(tx_hex) == 64:
+                hashes.append(tx_hex)
+            else:
+                final_transactions.append(await self._parse_tx(tx_hex))
+        if hashes:
+            found = await self.state.get_pending_transactions_by_hash(hashes)
+            if len(found) < len(hashes):
+                if sender:
+                    self._spawn(self.sync_blockchain(sender))
+                    return web.json_response({
+                        "ok": False,
+                        "error": "Transaction hash not found, had to sync "
+                                 "according to sender node, block may have "
+                                 "been accepted"})
+                return web.json_response(
+                    {"ok": False, "error": "Transaction hash not found"})
+            for h in found:
+                final_transactions.append(await self._parse_tx(h))
+
+        errors: list = []
+        if not await self.manager.create_block(
+                block_content, final_transactions, errors=errors):
+            return web.json_response(
+                {"ok": False, "error": errors[0]} if errors else {"ok": False})
+
+        if self.ws_hub is not None:
+            block_hash = hashlib.sha256(bytes.fromhex(block_content)).hexdigest()
+            info = await self._mining_info_result()
+            self._spawn(self.ws_hub.broadcast_new_block({
+                "block_no": block_no,
+                "block_hash": block_hash,
+                "transactions_count": len(final_transactions),
+                "timestamp": timestamp(),
+                **info,
+            }))
+        if sender:
+            self.peers.update_last_message(sender)
+        self._spawn(self.propagate("push_block", {
+            "block_content": block_content,
+            "txs": ([tx.hex() for tx in final_transactions]
+                    if len(final_transactions) < 10 else txs),
+            "block_no": block_no,
+        }))
+        return web.json_response({"ok": True})
+
+    async def h_sync_blockchain(self, request: web.Request) -> web.Response:
+        if self.is_syncing:
+            return web.json_response(
+                {"ok": False, "error": "Node is already syncing"})
+        node_url = request.rel_url.query.get("node_url")
+        resp = await self.sync_blockchain(node_url)
+        if isinstance(resp, str):
+            return web.json_response({"ok": False, "error": resp})
+        if isinstance(resp, Exception):
+            return web.json_response({"ok": False, "error": str(resp)})
+        return web.json_response({"ok": bool(resp)})
+
+    async def h_get_mining_info(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"ok": True, "result": await self._mining_info_result()})
+
+    async def h_get_validators_info(self, request: web.Request) -> web.Response:
+        """Inode ballot grouped by voting validator (main.py:698-725)."""
+        q = request.rel_url.query
+        inode = q.get("inode")
+        offset, limit = int(q.get("offset", 0)), min(int(q.get("limit", 100)), 1000)
+        rows = await self.state.get_ballots(
+            "inodes_ballot", inode, offset=offset, limit=limit)
+        by_validator: dict = {}
+        for row in rows:
+            ent = by_validator.setdefault(row["voter"], {
+                "validator": row["voter"], "vote": []})
+            ent["vote"].append({
+                "wallet": row["recipient"],
+                "vote_count": str(row["vote"]),
+                "tx_hash": row["tx_hash"],
+                "index": row["index"],
+            })
+            ent["totalStake"] = str(await self.state.get_validators_stake(
+                row["voter"], check_pending_txs=True))
+        return web.json_response(list(by_validator.values()))
+
+    async def h_get_delegates_info(self, request: web.Request) -> web.Response:
+        """Validator ballot grouped by voting delegate, batch stake
+        (main.py:727-764)."""
+        q = request.rel_url.query
+        validator = q.get("validator")
+        offset, limit = int(q.get("offset", 0)), min(int(q.get("limit", 100)), 1000)
+        rows = await self.state.get_ballots(
+            "validators_ballot", validator, offset=offset, limit=limit)
+        stakes = await self.state.get_multiple_address_stakes(
+            {row["voter"] for row in rows if row["voter"]},
+            check_pending_txs=True)
+        by_delegate: dict = {}
+        for row in rows:
+            ent = by_delegate.setdefault(row["voter"], {
+                "delegate": row["voter"], "vote": [], "totalStake": "0"})
+            ent["vote"].append({
+                "wallet": row["recipient"],
+                "vote_count": str(row["vote"]),
+                "tx_hash": row["tx_hash"],
+                "index": row["index"],
+            })
+            ent["totalStake"] = str(stakes.get(row["voter"], Decimal(0)))
+        return web.json_response(list(by_delegate.values()))
+
+    async def h_get_address_info(self, request: web.Request) -> web.Response:
+        q = request.rel_url.query
+        address = q.get("address")
+        if not address:
+            return web.json_response(
+                {"ok": False, "error": "Missing address"}, status=422)
+
+        def flag(name):
+            return q.get(name, "false").lower() in ("1", "true", "yes")
+
+        outputs = await self.state.get_spendable_outputs(address)
+        stake = await self.state.get_address_stake(address)
+        balance = sum(o.amount for o in outputs)
+
+        def out_list(rows):
+            return [{"amount": _fmt_amount(r["amount"]),
+                     "tx_hash": r["tx_hash"], "index": r["index"]} for r in rows]
+
+        result = {
+            "balance": _fmt_amount(balance),
+            "stake": str(stake),
+            "spendable_outputs": [
+                {"amount": _fmt_amount(o.amount), "tx_hash": o.tx_hash,
+                 "index": o.index} for o in outputs],
+            "pending_transactions": None,
+            "pending_spent_outputs": None,
+            "stake_outputs": None,
+            "delegate_spent_votes": None,
+            "delegate_unspent_votes": None,
+            "inode_registration_outputs": None,
+            "validator_unspent_votes": None,
+            "validator_spent_votes": None,
+            "is_inode": None,
+            "is_inode_active": None,
+            "is_validator": None,
+        }
+        def vote_list(rows):
+            return [{"amount": str(r["vote"]), "tx_hash": r["tx_hash"],
+                     "index": r["index"]} for r in rows]
+
+        if flag("show_pending"):
+            pending = await self.state.get_address_pending_transactions(address)
+            result["pending_transactions"] = [
+                await self.state.get_nice_transaction(
+                    tx.hash(), address if flag("verify") else None)
+                for tx in pending
+            ]
+            result["pending_spent_outputs"] = [
+                {"tx_hash": h, "index": i}
+                for h, i in await self.state.get_address_pending_spent_outpoints(address)
+            ]
+        if flag("stake_outputs"):
+            result["stake_outputs"] = out_list(
+                await self.state.get_outputs_by_address(
+                    "unspent_outputs", address, is_stake=True))
+        if flag("delegate_spent_votes"):
+            result["delegate_spent_votes"] = vote_list(
+                await self.state.get_delegates_spent_votes(address))
+        if flag("delegate_unspent_votes"):
+            result["delegate_unspent_votes"] = out_list(
+                await self.state.get_outputs_by_address(
+                    "delegates_voting_power", address))
+        if flag("inode_registration_outputs"):
+            result["inode_registration_outputs"] = out_list(
+                await self.state.get_outputs_by_address(
+                    "inode_registration_output", address))
+        if flag("validator_unspent_votes"):
+            result["validator_unspent_votes"] = out_list(
+                await self.state.get_outputs_by_address(
+                    "validators_voting_power", address))
+        if flag("validator_spent_votes"):
+            result["validator_spent_votes"] = vote_list(
+                await self.state.get_validators_spent_votes(address))
+        if flag("address_state"):
+            is_inode = await self.state.is_inode_registered(address)
+            result["is_inode"] = is_inode
+            if is_inode:
+                active = await self.manager.get_active_inodes_cached()
+                result["is_inode_active"] = any(
+                    e.get("wallet") == address for e in active)
+            else:
+                result["is_inode_active"] = False
+            result["is_validator"] = await self.state.is_validator_registered(address)
+        return web.json_response({"ok": True, "result": result})
+
+    async def h_get_address_transactions(self, request: web.Request) -> web.Response:
+        q = request.rel_url.query
+        address = q.get("address")
+        page = max(int(q.get("page", 1)), 1)
+        limit = min(int(q.get("limit", 5)), 1000)
+        rows = await self.state.get_address_transactions(
+            address, limit=limit, offset=(page - 1) * limit)
+        return web.json_response({"ok": True, "result": {
+            "transactions": [
+                await self.state.get_nice_transaction(r["tx_hash"])
+                for r in rows]
+        }})
+
+    async def h_add_node(self, request: web.Request) -> web.Response:
+        url = request.rel_url.query.get("url", "").strip("/")
+        if not url:
+            return web.json_response(
+                {"ok": False, "error": "Missing url"}, status=422)
+        if _normalize(url) == _normalize(self.self_url):
+            return web.json_response(
+                {"ok": False, "error": "Recursively adding node"})
+        if self.peers.contains(url):
+            return web.json_response(
+                {"ok": False, "error": "Node already present"})
+        iface = NodeInterface(url, self.config.node)
+        try:
+            await iface.get("")
+        except Exception:
+            return web.json_response(
+                {"ok": False, "error": "Could not add node"})
+        finally:
+            await iface.close()
+        self._spawn(self.propagate("add_node", {"url": url}, ignore_url=url))
+        self.peers.add(url)
+        return web.json_response({"ok": True, "result": "Node added"})
+
+    async def h_get_nodes(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"ok": True, "result": self.peers.recent_nodes()[:100]})
+
+    async def h_get_pending_transactions(self, request: web.Request) -> web.Response:
+        txs = await self.state.get_pending_transactions_limit(hex_only=True)
+        return web.json_response({"ok": True, "result": txs})
+
+    async def h_get_transaction(self, request: web.Request) -> web.Response:
+        tx_hash = request.rel_url.query.get("tx_hash", "")
+        tx = await self.state.get_nice_transaction(tx_hash)
+        if tx is None:
+            return web.json_response(
+                {"ok": False, "error": "Transaction not found"})
+        return web.json_response({"ok": True, "result": tx})
+
+    async def _block_lookup(self, block: str) -> Optional[dict]:
+        if block.isdecimal():
+            return await self.state.get_block_by_id(int(block))
+        return await self.state.get_block(block)
+
+    async def h_get_block(self, request: web.Request) -> web.Response:
+        q = request.rel_url.query
+        block = q.get("block", "")
+        full = q.get("full_transactions", "false").lower() in ("1", "true")
+        info = await self._block_lookup(block)
+        if not info:
+            return web.json_response({"ok": False, "error": "Block not found"})
+        block_hash = info["hash"]
+        return web.json_response({"ok": True, "result": {
+            "block": _json_block(info),
+            "transactions": (
+                await self.state.get_block_transactions(block_hash, hex_only=True)
+                if not full else None),
+            "full_transactions": (
+                await self.state.get_block_nice_transactions(block_hash)
+                if full else None),
+        }})
+
+    async def h_get_block_details(self, request: web.Request) -> web.Response:
+        block = request.rel_url.query.get("block", "")
+        info = await self._block_lookup(block)
+        if not info:
+            return web.json_response({"ok": False, "error": "Block not found"})
+        hashes = await self.state.get_block_transaction_hashes(info["hash"])
+        return web.json_response({"ok": True, "result": {
+            "block": _json_block(info),
+            "transactions": [
+                await self.state.get_nice_transaction(h) for h in hashes],
+        }})
+
+    async def h_get_blocks(self, request: web.Request) -> web.Response:
+        q = request.rel_url.query
+        offset = int(q.get("offset", 0))
+        limit = min(int(q.get("limit", 100)), 1000)
+        blocks = await self.state.get_blocks(offset, limit)
+        return web.json_response({"ok": True, "result": blocks})
+
+    async def h_get_blocks_details(self, request: web.Request) -> web.Response:
+        q = request.rel_url.query
+        offset = int(q.get("offset", 0))
+        limit = min(int(q.get("limit", 100)), 1000)
+        blocks = await self.state.get_blocks(offset, limit, tx_details=True)
+        return web.json_response({"ok": True, "result": blocks})
+
+    async def h_dobby_info(self, request: web.Request) -> web.Response:
+        inodes = await self.manager.get_active_inodes_cached()
+        data = [
+            {**item, "emission": f"{item['emission']:.2f}%"
+             if isinstance(item["emission"], Decimal)
+             else str(item["emission"]) + "%"}
+            for item in inodes
+        ]
+        return web.json_response({"ok": True, "result": data},
+                                 dumps=_json_dumps)
+
+    async def h_get_supply_info(self, request: web.Request) -> web.Response:
+        last_block = await self.state.get_last_block()
+        last_id = last_block["id"] if last_block else 0
+        return web.json_response({"ok": True, "result": {
+            "max_supply": float(MAX_SUPPLY),
+            "circulating_supply": float(get_circulating_supply(last_id)),
+            "last_block": _json_block(last_block),
+        }})
+
+    async def h_send_to_address(self, request: web.Request) -> web.Response:
+        """Localhost-only custodial send (main.py:481-518): looks up the
+        wallet keystore by the Authorization pubkey, builds + pushes."""
+        params = await self._params(request)
+        to_address = params.get("to_address")
+        amount = params.get("amount")
+        if not to_address or not amount:
+            return web.json_response(
+                {"ok": False, "error": "Missing required params."}, status=422)
+        auth = request.headers.get("Authorization")
+        from ..wallet.keystore import KeyStore
+
+        store = KeyStore()
+        private_key = store.private_key_for_public(auth)
+        if private_key is None:
+            return web.json_response({"ok": False, "error": "Unauthorized"})
+        from ..wallet.builders import WalletBuilder
+
+        builder = WalletBuilder(self.state)
+        try:
+            tx = await builder.create_transaction(
+                private_key, to_address, Decimal(str(amount)))
+        except Exception as e:
+            return web.json_response({"ok": False, "error": str(e)})
+        result = await self._verify_and_push_tx(
+            tx, request.headers.get("Sender-Node"))
+        return web.json_response(result)
+
+    # ------------------------------------------------------------ sync ----
+    async def sync_blockchain(self, node_url: Optional[str] = None):
+        """Guarded wrapper (main.py:230-243)."""
+        if self.is_syncing:
+            return "Node is already syncing"
+        self.is_syncing = True
+        self.manager.is_syncing = True
+        try:
+            return await self._sync_blockchain(node_url)
+        except Exception as e:
+            log.error("sync_blockchain error: %s", e)
+            return e
+        finally:
+            self.is_syncing = False
+            self.manager.is_syncing = False
+
+    async def _sync_blockchain(self, node_url: Optional[str] = None):
+        """Fork detection + paged download (main.py:153-227)."""
+        cfg = self.config.node
+        if not node_url:
+            nodes = self.peers.recent_nodes()
+            if not nodes:
+                return "No nodes found."
+            node_url = random.choice(nodes)
+        iface = NodeInterface(node_url, cfg)
+        try:
+            _, last_block = await self.manager.calculate_difficulty()
+            starting_from = i = await self.state.get_next_block_id()
+            local_cache = None
+            last_common_block = 0
+            if last_block and last_block.get("id", 0) > cfg.sync_reorg_window:
+                remote_last = (await iface.get_block(i - 1))["block"]
+                if remote_last["hash"] != last_block["hash"]:
+                    offset = i - cfg.sync_reorg_window
+                    remote_blocks = await iface.get_blocks(
+                        offset, cfg.sync_reorg_window)
+                    local_blocks = await self.state.get_blocks(
+                        offset, cfg.sync_reorg_window)
+                    local_blocks = local_blocks[: len(remote_blocks)]
+                    local_blocks.reverse()
+                    remote_blocks.reverse()
+                    for n, local in enumerate(local_blocks):
+                        if local["block"]["hash"] == remote_blocks[n]["block"]["hash"]:
+                            last_common_block = local["block"]["id"]
+                            local_cache = local_blocks[:n]
+                            local_cache.reverse()
+                            await self.state.remove_blocks(last_common_block + 1)
+                            break
+            errors: list = []
+            while True:
+                i = await self.state.get_next_block_id()
+                try:
+                    blocks = await iface.get_blocks(i, cfg.sync_page)
+                except Exception as e:
+                    log.error("sync fetch failed: %s", e)
+                    break
+                try:
+                    _, last_block = await self.manager.calculate_difficulty()
+                    if not blocks:
+                        log.info("syncing complete")
+                        if last_block and last_block.get("id", 0) > starting_from:
+                            self.peers.update_last_message(node_url)
+                            tip = await self.state.get_last_block()
+                            if tip and timestamp() - tip["timestamp"] < 86400:
+                                hashes = await self.state.get_block_transaction_hashes(
+                                    tip["hash"])
+                                await self.propagate("push_block", {
+                                    "block_content": tip["content"],
+                                    "txs": hashes,
+                                    "block_no": tip["id"],
+                                }, ignore_url=node_url)
+                        return True
+                    assert await self.create_blocks(blocks, errors)
+                except Exception as e:
+                    log.error("sync failed: %s", errors[0] if errors else e)
+                    if local_cache is not None:
+                        log.info("reverting to previous chain")
+                        await self.state.remove_blocks(last_common_block + 1)
+                        await self.create_blocks(local_cache, [])
+                    return errors[0] if errors else e
+            return True
+        finally:
+            await iface.close()
+
+    async def create_blocks(self, blocks: list,
+                            errors: Optional[list] = None) -> bool:
+        """Batch ingest for sync (main.py:97-150): recompute the merkle,
+        rebuild content when absent, accept via the sync path that trusts
+        the embedded coinbase."""
+        errors = errors if errors is not None else []
+        _, last_block = await self.manager.calculate_difficulty()
+        last_id = last_block["id"] if last_block else 0
+        last_hash = last_block["hash"] if last_block else GENESIS_PREV_HASH
+        i = last_id + 1
+        for block_info in blocks:
+            block = dict(block_info["block"])
+            txs_hex = block_info["transactions"]
+            txs = [await self._parse_tx(t) for t in txs_hex]
+            coinbase = None
+            for tx in txs:
+                if isinstance(tx, CoinbaseTx):
+                    txs.remove(tx)
+                    coinbase = tx
+                    break
+            block["merkle_tree"] = merkle_root(txs)
+            content = block.get("content") or block_to_bytes(last_hash, block).hex()
+            if int(block["id"]) != i:
+                errors.append(f"unexpected block id {block['id']} != {i}")
+                return False
+            if coinbase is None:
+                errors.append(f"block {i} has no coinbase")
+                return False
+            if not await self.manager.create_block_syncing(
+                    content, txs, coinbase, errors=errors):
+                return False
+            last_hash = block["hash"]
+            i += 1
+        return True
+
+    # --------------------------------------------------------- app build --
+    def _build_app(self) -> web.Application:
+        app = web.Application(middlewares=[self._middleware],
+                              client_max_size=self.config.node.response_cap)
+        r = app.router
+        r.add_get("/", self.h_root)
+        for path, handler in [
+            ("/push_tx", self.h_push_tx),
+            ("/push_block", self.h_push_block),
+            ("/send_to_address", self.h_send_to_address),
+        ]:
+            r.add_get(path, handler)
+            r.add_post(path, handler)
+        for path, handler in [
+            ("/sync_blockchain", self.h_sync_blockchain),
+            ("/get_mining_info", self.h_get_mining_info),
+            ("/get_validators_info", self.h_get_validators_info),
+            ("/get_delegates_info", self.h_get_delegates_info),
+            ("/get_address_info", self.h_get_address_info),
+            ("/get_address_transactions", self.h_get_address_transactions),
+            ("/add_node", self.h_add_node),
+            ("/get_nodes", self.h_get_nodes),
+            ("/get_pending_transactions", self.h_get_pending_transactions),
+            ("/get_transaction", self.h_get_transaction),
+            ("/get_block", self.h_get_block),
+            ("/get_block_details", self.h_get_block_details),
+            ("/get_blocks", self.h_get_blocks),
+            ("/get_blocks_details", self.h_get_blocks_details),
+            ("/dobby_info", self.h_dobby_info),
+            ("/get_supply_info", self.h_get_supply_info),
+        ]:
+            r.add_get(path, handler)
+        if self.config.ws.enabled:
+            from ..ws.hub import WsHub
+
+            self.ws_hub = WsHub(self.config.ws)
+            r.add_get("/ws", self.ws_hub.handle)
+        return app
+
+
+def _json_block(block: Optional[dict]) -> dict:
+    """Blocks carry Decimal difficulty/reward; make them JSON-clean the way
+    the reference's FastAPI encoder does (floats/strings)."""
+    if not block:
+        return {}
+    out = dict(block)
+    if "difficulty" in out:
+        out["difficulty"] = float(out["difficulty"])
+    if "reward" in out:
+        out["reward"] = str(out["reward"])
+    return out
+
+
+def _json_dumps(obj) -> str:
+    def default(o):
+        if isinstance(o, Decimal):
+            return str(o)
+        raise TypeError(type(o))
+    return json.dumps(obj, default=default)
+
+
+def run(config: Optional[Config] = None) -> None:
+    """Launcher (reference run_node.py): serve the node app."""
+    node = Node(config)
+    web.run_app(node.app, host=node.config.node.host,
+                port=node.config.node.port)

@@ -1,0 +1,80 @@
+"""IP filtering + local-address guard.
+
+Mirrors the reference's two small pieces of endpoint-surface policy:
+
+* ``ip_config.json`` hot-reloaded whitelist / blocklist / per-endpoint
+  blocks (upow/node/ip_manager.py:8-52), reload every 300 s.
+* the private-range table guarding the custodial ``send_to_address``
+  endpoint (upow/node/utils.py:4-31).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import json
+import os
+import time
+from typing import Optional
+
+_PRIVATE_NETS = [
+    ipaddress.ip_network(n)
+    for n in (
+        "127.0.0.0/8",      # loopback
+        "10.0.0.0/8",       # RFC1918
+        "172.16.0.0/12",
+        "192.168.0.0/16",
+        "100.64.0.0/10",    # CGNAT
+        "169.254.0.0/16",   # link-local
+        "::1/128",
+        "fc00::/7",
+        "fe80::/10",
+    )
+]
+
+
+def is_local_ip(ip: str) -> bool:
+    try:
+        addr = ipaddress.ip_address(ip)
+    except ValueError:
+        return False
+    return any(addr in net for net in _PRIVATE_NETS)
+
+
+class IpFilter:
+    """whitelist > blocklist > block_endpoints, hot-reloaded."""
+
+    def __init__(self, path: str = "ip_config.json",
+                 reload_every: float = 300.0):
+        self.path = path
+        self.reload_every = reload_every
+        self._loaded_at = 0.0
+        self.whitelist: set = set()
+        self.blocklist: set = set()
+        self.block_endpoints: set = set()
+        self._maybe_reload(force=True)
+
+    def _maybe_reload(self, force: bool = False) -> None:
+        now = time.time()
+        if not force and now - self._loaded_at < self.reload_every:
+            return
+        self._loaded_at = now
+        if not self.path or not os.path.exists(self.path):
+            return
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            self.whitelist = set(data.get("whitelist", []))
+            self.blocklist = set(data.get("blocklist", []))
+            self.block_endpoints = set(data.get("block_endpoints", []))
+        except (json.JSONDecodeError, OSError):
+            pass
+
+    def allowed(self, ip: str, endpoint: Optional[str] = None) -> bool:
+        self._maybe_reload()
+        if ip in self.whitelist:
+            return True
+        if ip in self.blocklist:
+            return False
+        if endpoint is not None and endpoint.strip("/") in self.block_endpoints:
+            return False
+        return True

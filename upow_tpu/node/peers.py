@@ -1,0 +1,191 @@
+"""Peer book + HTTP RPC client (reference upow/node/nodes_manager.py:24-210).
+
+Semantics replicated: a JSON peer file guarded by a file lock; peers are
+"active" if they messaged us within 7 days, pruned after 90 days of
+silence, capped at 100; the propagate set is a sample of up to 10 active
+plus up to 10 never-seen peers; RPC requests carry a ``Sender-Node``
+header as the return address and responses are capped at 20 MB.
+
+Transport is aiohttp (the reference uses httpx) — one shared session per
+process, created lazily on the running loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+from typing import Dict, List, Optional
+
+import aiohttp
+from filelock import FileLock
+
+from ..config import NodeConfig
+from ..logger import get_logger
+
+log = get_logger("peers")
+
+
+def _normalize(url: str) -> str:
+    url = (url or "").strip().strip("/")
+    if url and not url.startswith("http"):
+        url = "http://" + url
+    return url
+
+
+class PeerBook:
+    """Durable peer registry with active/unseen classes and pruning."""
+
+    def __init__(self, cfg: Optional[NodeConfig] = None):
+        self.cfg = cfg or NodeConfig()
+        self.path = self.cfg.peers_file
+        self._lock = FileLock(self.path + ".lock") if self.path else None
+        self._data: Dict[str, dict] = {}
+        self._load()
+        if not self._data and self.cfg.seed_url:
+            self.add(self.cfg.seed_url)
+
+    # ------------------------------------------------------- persistence --
+    def _load(self) -> None:
+        if self.path and os.path.exists(self.path):
+            try:
+                with open(self.path) as f:
+                    self._data = json.load(f).get("nodes", {})
+            except (json.JSONDecodeError, OSError):
+                self._data = {}
+
+    def save(self) -> None:
+        if not self.path:
+            return
+        with self._lock:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"nodes": self._data}, f)
+            os.replace(tmp, self.path)
+
+    # ------------------------------------------------------------ updates --
+    def add(self, url: str) -> bool:
+        url = _normalize(url)
+        if not url or url in self._data:
+            return False
+        if len(self._data) >= self.cfg.max_peers:
+            self.prune()
+            if len(self._data) >= self.cfg.max_peers:
+                return False
+        self._data[url] = {"added": int(time.time()), "last_message": 0}
+        self.save()
+        return True
+
+    def update_last_message(self, url: str) -> None:
+        url = _normalize(url)
+        if url in self._data:
+            self._data[url]["last_message"] = int(time.time())
+            self.save()
+
+    def remove(self, url: str) -> None:
+        if self._data.pop(_normalize(url), None) is not None:
+            self.save()
+
+    def prune(self) -> None:
+        """Drop peers silent for prune_after (but keep never-seen entries
+        younger than that, by their added time)."""
+        now = time.time()
+        doomed = [
+            u for u, meta in self._data.items()
+            if now - max(meta.get("last_message", 0), meta.get("added", 0))
+            > self.cfg.prune_after
+        ]
+        for u in doomed:
+            del self._data[u]
+        if doomed:
+            self.save()
+
+    # ------------------------------------------------------------- reads --
+    def all_nodes(self) -> List[str]:
+        return list(self._data)
+
+    def recent_nodes(self) -> List[str]:
+        """Peers that messaged us within the active window; falls back to
+        everything known when nobody has (fresh node bootstrapping from
+        the seed)."""
+        now = time.time()
+        active = [
+            u for u, meta in self._data.items()
+            if now - meta.get("last_message", 0) < self.cfg.active_within
+            and meta.get("last_message", 0) > 0
+        ]
+        return active or list(self._data)
+
+    def propagate_nodes(self) -> List[str]:
+        """≤10 random active + ≤10 random never-seen (nodes_manager.py:144-149)."""
+        k = self.cfg.propagate_sample
+        active = [
+            u for u, meta in self._data.items() if meta.get("last_message", 0) > 0
+        ]
+        unseen = [u for u in self._data if u not in set(active)]
+        picks = random.sample(active, min(k, len(active)))
+        picks += random.sample(unseen, min(k, len(unseen)))
+        return picks
+
+    def contains(self, url: str) -> bool:
+        return _normalize(url) in self._data
+
+
+class NodeInterface:
+    """RPC client for one remote node (nodes_manager.py:174-210)."""
+
+    def __init__(self, url: str, cfg: Optional[NodeConfig] = None,
+                 session: Optional[aiohttp.ClientSession] = None):
+        self.base_url = _normalize(url)
+        self.url = self.base_url
+        self.cfg = cfg or NodeConfig()
+        self._session = session
+
+    async def _get_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=30))
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def _read_capped(self, resp: aiohttp.ClientResponse) -> dict:
+        buf = b""
+        async for chunk in resp.content.iter_chunked(64 * 1024):
+            buf += chunk
+            if len(buf) > self.cfg.response_cap:
+                raise ValueError("response too large")
+        return json.loads(buf or b"{}")
+
+    async def request(self, path: str, args: dict,
+                      sender_node: str = "") -> dict:
+        session = await self._get_session()
+        headers = {"Sender-Node": sender_node} if sender_node else {}
+        async with session.post(f"{self.base_url}/{path}", json=args,
+                                headers=headers) as resp:
+            return await self._read_capped(resp)
+
+    async def get(self, path: str, params: Optional[dict] = None,
+                  sender_node: str = "") -> dict:
+        session = await self._get_session()
+        headers = {"Sender-Node": sender_node} if sender_node else {}
+        async with session.get(f"{self.base_url}/{path}",
+                               params=params or {}, headers=headers) as resp:
+            return await self._read_capped(resp)
+
+    async def get_block(self, block_no: int) -> dict:
+        res = await self.get("get_block", {"block": str(block_no),
+                                           "full_transactions": "false"})
+        return res["result"]
+
+    async def get_blocks(self, offset: int, limit: int) -> list:
+        res = await self.get("get_blocks", {"offset": str(offset),
+                                            "limit": str(limit)})
+        return res["result"]
+
+    async def get_nodes(self) -> list:
+        res = await self.get("get_nodes")
+        return res["result"]

@@ -1,0 +1,25 @@
+"""Launcher: ``python -m upow_tpu.node.run [--config cfg.json]``
+(reference run_node.py / upow/node/run.py)."""
+
+import argparse
+
+from ..config import Config
+from .app import run
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser("upow_tpu node")
+    parser.add_argument("--config", default=None)
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--db", default=None)
+    args = parser.parse_args()
+    overrides = {}
+    if args.port is not None:
+        overrides["node__port"] = args.port
+    if args.db is not None:
+        overrides["node__db_path"] = args.db
+    run(Config.load(args.config, **overrides))
+
+
+if __name__ == "__main__":
+    main()

@@ -56,7 +56,13 @@ def _dedup_sig_checks(tx: Tx, voter: bool,
             pub = string_to_point(address)
         except (ValueError, NotImplementedError):
             return None
-        key = (pub, tx_input.signature)
+        # Consensus-exact dedup: the reference keys on
+        # (tx_input.public_key, signature) but from_hex never sets
+        # public_key (transaction.py:148-163, 520-592), so its runtime key
+        # degenerates to the signature value ALONE — a later input reusing
+        # an earlier input's (r, s) is skipped even under a different
+        # address.  Replicate that exactly; hardening here would fork.
+        key = tx_input.signature
         if key in seen:
             continue
         seen.add(key)

@@ -1,0 +1,4 @@
+"""Wallet / client SDK (reference upow/upow_wallet/)."""
+
+from .builders import WalletBuilder  # noqa: F401
+from .keystore import KeyStore  # noqa: F401

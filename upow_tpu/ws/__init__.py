@@ -1,0 +1,3 @@
+"""WebSocket push sidecar (reference ``websocket/`` ~1.1k LoC)."""
+
+from .hub import WsHub  # noqa: F401

@@ -1,0 +1,261 @@
+"""WebSocket push hub: channels, caps, heartbeats, typed broadcasts.
+
+One class covers what the reference spreads over five modules
+(websocket/socket_endpoint.py, socket_manager.py, socket_connection.py,
+socket_handlers.py, socket_utils.py — ~1.1k LoC): per-channel subscriber
+sets, per-IP connection caps, a token-bucket message rate limit, 64 KB
+message cap, heartbeat pings with idle expiry, and the two typed
+broadcasts ``new_block`` / ``new_transaction``.
+
+Wire compatibility with the reference client protocol:
+``{"type": "subscribe_block"|"unsubscribe_block"|"ping"|"pong"}`` in,
+``{"type": "new_block"|"new_transaction", "data": ..., "timestamp": ...}``
+out.  ``subscribe_transaction`` is ALSO accepted here: the reference
+routes it (socket_handlers.py:23-31) but forgot it in
+ALLOWED_MESSAGE_TYPES (socket_config.py:18-23), making it unreachable —
+an evident bug, fixed rather than replicated since no working reference
+client can depend on the broken behavior.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from datetime import datetime, timezone
+from typing import Dict, Optional, Set
+
+from aiohttp import WSMsgType, web
+
+from ..config import WsConfig
+from ..logger import get_logger
+
+log = get_logger("ws")
+
+_SUBSCRIBE = {
+    "subscribe_block": ("block", True),
+    "unsubscribe_block": ("block", False),
+    "subscribe_transaction": ("transaction", True),
+    "unsubscribe_transaction": ("transaction", False),
+}
+
+
+class WsConnection:
+    """Per-connection state: socket, subscriptions, rate bucket, stats."""
+
+    def __init__(self, ws: web.WebSocketResponse, ip: str, cfg: WsConfig):
+        self.id = uuid.uuid4().hex[:12]
+        self.ws = ws
+        self.ip = ip
+        self.cfg = cfg
+        self.channels: Set[str] = set()
+        self.connected_at = time.monotonic()
+        self.last_activity = time.monotonic()
+        self.messages_in = 0
+        self.messages_out = 0
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self._bucket_times: list = []
+
+    def rate_ok(self) -> bool:
+        now = time.monotonic()
+        self._bucket_times = [t for t in self._bucket_times if now - t < 60.0]
+        if len(self._bucket_times) >= self.cfg.rate_limit_per_minute:
+            return False
+        self._bucket_times.append(now)
+        return True
+
+    async def send(self, message: dict) -> bool:
+        try:
+            payload = json.dumps(message)
+            await self.ws.send_str(payload)
+            self.messages_out += 1
+            self.bytes_out += len(payload)
+            return True
+        except (ConnectionError, RuntimeError):
+            return False
+
+    async def send_error(self, code: str, text: str) -> None:
+        await self.send({"type": "error", "error_code": code, "message": text})
+
+    async def send_success(self, text: str, data: Optional[dict] = None) -> None:
+        await self.send({"type": "success", "message": text, "data": data or {}})
+
+
+class WsHub:
+    """Connection registry + channel broadcast + lifecycle loops."""
+
+    def __init__(self, cfg: Optional[WsConfig] = None):
+        self.cfg = cfg or WsConfig()
+        self.connections: Dict[str, WsConnection] = {}
+        self.by_ip: Dict[str, Set[str]] = {}
+        self.channels: Dict[str, Set[str]] = {c: set() for c in self.cfg.channels}
+        self._loops_started = False
+
+    # ------------------------------------------------------------ endpoint --
+    async def handle(self, request: web.Request) -> web.WebSocketResponse:
+        """The /ws route (reference socket_endpoint.py:26-52)."""
+        ip = request.headers.get("x-real-ip") or (
+            request.transport.get_extra_info("peername") or ("", 0))[0]
+        if len(self.connections) >= self.cfg.max_connections:
+            raise web.HTTPServiceUnavailable(text="Too many connections")
+        if len(self.by_ip.get(ip, ())) >= self.cfg.max_per_user:
+            raise web.HTTPForbidden(text="Too many connections from this IP")
+
+        ws = web.WebSocketResponse(
+            heartbeat=self.cfg.heartbeat_interval,
+            max_msg_size=self.cfg.max_message_bytes)
+        await ws.prepare(request)
+        conn = WsConnection(ws, ip, self.cfg)
+        self.connections[conn.id] = conn
+        self.by_ip.setdefault(ip, set()).add(conn.id)
+        self._ensure_loops()
+        log.info("ws connect %s from %s (%d total)", conn.id, ip,
+                 len(self.connections))
+        await conn.send({"type": "connection_established",
+                         "connection_id": conn.id,
+                         "channels": list(self.cfg.channels)})
+        try:
+            async for msg in ws:
+                conn.last_activity = time.monotonic()
+                if msg.type == WSMsgType.TEXT:
+                    conn.messages_in += 1
+                    conn.bytes_in += len(msg.data)
+                    await self._on_message(conn, msg.data)
+                elif msg.type in (WSMsgType.ERROR, WSMsgType.CLOSE):
+                    break
+        finally:
+            self._drop(conn)
+        return ws
+
+    async def _on_message(self, conn: WsConnection, raw: str) -> None:
+        if not conn.rate_ok():
+            await conn.send_error("RATE_LIMIT_EXCEEDED", "Too many messages sent")
+            return
+        try:
+            message = json.loads(raw)
+        except json.JSONDecodeError:
+            await conn.send_error("INVALID_JSON", "Message must be valid JSON")
+            return
+        mtype = message.get("type")
+        if not mtype:
+            await conn.send_error("INVALID_MESSAGE", "Message type is required")
+            return
+        if mtype == "ping":
+            await conn.send({"type": "pong"})
+            return
+        if mtype == "pong":
+            return
+        if mtype in _SUBSCRIBE:
+            channel, subscribe = _SUBSCRIBE[mtype]
+            if channel not in self.channels:
+                await conn.send_error("INVALID_CHANNEL",
+                                      f"Unknown channel '{channel}'")
+                return
+            if subscribe:
+                conn.channels.add(channel)
+                self.channels[channel].add(conn.id)
+                await conn.send_success(f"Subscribed to {channel}",
+                                        {"channel": channel})
+            else:
+                if channel not in conn.channels:
+                    await conn.send_error(
+                        "NOT_SUBSCRIBED", f"Not subscribed to channel '{channel}'")
+                    return
+                conn.channels.discard(channel)
+                self.channels[channel].discard(conn.id)
+                await conn.send_success(f"Unsubscribed from {channel}",
+                                        {"channel": channel})
+            return
+        await conn.send_error("INVALID_MESSAGE_TYPE",
+                              f"Message type '{mtype}' not allowed")
+
+    def _drop(self, conn: WsConnection) -> None:
+        self.connections.pop(conn.id, None)
+        self.by_ip.get(conn.ip, set()).discard(conn.id)
+        if not self.by_ip.get(conn.ip):
+            self.by_ip.pop(conn.ip, None)
+        for members in self.channels.values():
+            members.discard(conn.id)
+
+    # ----------------------------------------------------------- broadcast --
+    async def broadcast_to_channel(self, channel: str, message: dict) -> int:
+        """Send to every subscriber; reap dead connections
+        (reference socket_manager.py:201-231)."""
+        sent = 0
+        for conn_id in list(self.channels.get(channel, ())):
+            conn = self.connections.get(conn_id)
+            if conn is None:
+                self.channels[channel].discard(conn_id)
+                continue
+            if await conn.send(message):
+                sent += 1
+            else:
+                self._drop(conn)
+        return sent
+
+    async def broadcast_new_block(self, block_data: dict) -> int:
+        return await self.broadcast_to_channel("block", {
+            "type": "new_block", "data": block_data,
+            "timestamp": datetime.now(timezone.utc).isoformat(),
+        })
+
+    async def broadcast_new_transaction(self, tx_data: dict) -> int:
+        return await self.broadcast_to_channel("transaction", {
+            "type": "new_transaction", "data": tx_data,
+            "timestamp": datetime.now(timezone.utc).isoformat(),
+        })
+
+    # ----------------------------------------------------------- lifecycle --
+    def _ensure_loops(self) -> None:
+        if self._loops_started:
+            return
+        self._loops_started = True
+        asyncio.ensure_future(self._cleanup_loop())
+        asyncio.ensure_future(self._stats_loop())
+
+    async def _cleanup_loop(self) -> None:
+        """Expire idle connections (reference socket_manager.py:333-352)."""
+        while True:
+            await asyncio.sleep(60)
+            now = time.monotonic()
+            for conn in list(self.connections.values()):
+                if now - conn.last_activity > self.cfg.connection_expiry:
+                    log.info("ws expire %s", conn.id)
+                    try:
+                        await conn.ws.close()
+                    except Exception:
+                        pass
+                    self._drop(conn)
+
+    async def _stats_loop(self) -> None:
+        while True:
+            await asyncio.sleep(300)
+            log.info("ws stats: %s", self.get_stats())
+
+    def get_stats(self) -> dict:
+        return {
+            "total_connections": len(self.connections),
+            "unique_ips": len(self.by_ip),
+            "channels": {c: len(m) for c, m in self.channels.items()},
+            "messages_out": sum(c.messages_out for c in self.connections.values()),
+            "messages_in": sum(c.messages_in for c in self.connections.values()),
+        }
+
+    def get_detailed_stats(self) -> dict:
+        return {
+            **self.get_stats(),
+            "connections": [
+                {
+                    "id": c.id, "ip": c.ip,
+                    "channels": sorted(c.channels),
+                    "age_seconds": round(time.monotonic() - c.connected_at, 1),
+                    "messages_in": c.messages_in,
+                    "messages_out": c.messages_out,
+                    "bytes_in": c.bytes_in,
+                    "bytes_out": c.bytes_out,
+                }
+                for c in self.connections.values()
+            ],
+        }
