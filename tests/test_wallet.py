@@ -128,11 +128,21 @@ def test_governance_end_to_end():
         d_v, a_v = actors["validator"]
         d_d, a_d = actors["delegate"]
 
-        # fund the actors: ~190 blocks of 6-coin rewards to the genesis key
-        for _ in range(190):
+        # fund the actors: 360 blocks of 6-coin rewards to the genesis key
+        # (2160 coins ≥ the 2143 sent below).  The validator gets 1111 so
+        # that after registration (100) + stake (10) it still holds ≥1000:
+        # the builders check funds BEFORE registration status (reference
+        # utils.py:327-341), so the duplicate-registration and
+        # validator-cannot-be-inode paths below are only reachable with
+        # funds in place.
+        for _ in range(360):
             await mine_block(manager, state, a_g)
+        # two sends: one tx spending all 358 six-coin coinbase outputs
+        # would blow the 255-input cap (reference transaction.py:24-27)
         tx = await builder.create_transaction_to_send_multiple_wallet(
-            d_g, [a_i, a_v, a_d], ["1011", "111", "21"])
+            d_g, [a_i, a_d], ["1011", "21"])
+        await push(state, tx)
+        tx = await builder.create_transaction(d_g, a_v, "1111")
         await push(state, tx)
         await mine_block(manager, state, a_g, include_pending=True)
 
@@ -219,7 +229,7 @@ def test_inode_deregistration_and_validator_revoke():
         d_i, a_i = actors["inode"]
         d_v, a_v = actors["validator"]
         d_d, a_d = actors["delegate"]
-        for _ in range(190):
+        for _ in range(195):
             await mine_block(manager, state, a_g)
         tx = await builder.create_transaction_to_send_multiple_wallet(
             d_g, [a_i, a_v, a_d], ["1011", "111", "21"])

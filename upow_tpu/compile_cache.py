@@ -1,0 +1,51 @@
+"""Persistent XLA compile cache, keyed per host fingerprint.
+
+JAX's persistent cache stores XLA:CPU AOT executables whose code is
+specialised to the *compiling* machine's CPU features.  When the cache
+directory is shared between machines (this repo's ``.jax_cache`` travels
+with the checkout), loading an entry produced by a host with a different
+feature set logs ``cpu_aot_loader`` feature-mismatch errors and can run
+miscompiled code (observed: an execution that never completes).  Keying
+the directory by a host fingerprint keeps reruns on the same machine
+instant while making foreign entries invisible.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import platform
+
+
+def host_fingerprint() -> str:
+    """Stable per-machine tag: arch + CPU flag set (+ model name)."""
+    bits = [platform.machine()]
+    try:
+        seen = set()
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                key = line.split(":", 1)[0].strip()
+                # one of each: the FLAGS are what the AOT cache entries
+                # are specialised to; model name disambiguates further
+                if key in ("flags", "Features", "model name") and key not in seen:
+                    seen.add(key)
+                    bits.append(line.strip())
+    except OSError:
+        bits.append(platform.processor() or "unknown")
+    return hashlib.sha256("|".join(bits).encode()).hexdigest()[:12]
+
+
+def enable(cache_root: str) -> str:
+    """Point JAX's persistent compile cache at a per-host subdir of
+    ``cache_root``.  Never raises; returns the directory used ('' on
+    failure)."""
+    import jax
+
+    path = os.path.join(cache_root, host_fingerprint())
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+        return path
+    except Exception:
+        return ""

@@ -187,14 +187,29 @@ def _rotr(x, n: int):
     return (x >> n) | (x << (32 - n))
 
 
-def _compress_tail(midstate, w):
+def _compress_tail(midstate, w, unroll: bool | None = None):
     """One compression over message words ``w`` (list of 16 u32 arrays),
     starting from ``midstate`` (tuple of 8 u32 arrays/scalars).
 
-    Fully unrolled: 64 rounds + 48 schedule extensions, all elementwise on
-    whatever batch shape ``w``'s elements carry — VPU-friendly, no
-    data-dependent control flow, so XLA/Mosaic vectorise it flat.
+    Two compilations of the same math:
+
+    * ``unroll=True`` — 64 rounds + 48 schedule extensions flattened into
+      straight-line code.  Fastest on TPU (Mosaic/XLA:TPU vectorise it
+      flat and compile it quickly) but XLA:CPU's pass pipeline goes
+      super-linear on the unrolled graph (its algebraic simplifier logs
+      "circular simplification loop"; minutes of compile on small hosts).
+    * ``unroll=False`` — a 64-iteration ``lax.fori_loop`` whose body does
+      one round plus one schedule extension over a rolling 16-word
+      window.  Tiny HLO: compiles in seconds anywhere.  Used on CPU
+      (tests, the multichip dryrun) where compile time dominates.
+
+    Default: unrolled exactly when the default backend is a real
+    accelerator.
     """
+    if unroll is None:
+        unroll = jax.default_backend() != "cpu"
+    if not unroll:
+        return _compress_tail_rolled(midstate, w)
     w = list(w)
     a, b, c, d, e, f, g, h = midstate
     for i in range(64):
@@ -211,6 +226,42 @@ def _compress_tail(midstate, w):
         t2 = s0 + maj
         a, b, c, d, e, f, g, h = t1 + t2, a, b, c, d + t1, e, f, g
     return tuple(x + y for x, y in zip(midstate, (a, b, c, d, e, f, g, h)))
+
+
+def _compress_tail_rolled(midstate, w):
+    """Rolled form of :func:`_compress_tail` (see its docstring).
+
+    Invariant: at the start of round ``i`` the window holds
+    ``w[i] .. w[i+15]``; the body consumes ``window[0]`` and appends
+    ``w[i+16] = w[i] + s0(w[i+1]) + w[i+9] + s1(w[i+14])`` (garbage past
+    round 47, never read)."""
+    shape = jnp.broadcast_shapes(*(jnp.shape(x) for x in w))
+    window = jnp.stack([jnp.broadcast_to(x, shape).astype(jnp.uint32) for x in w])
+    state = jnp.stack([
+        jnp.broadcast_to(jnp.asarray(s, jnp.uint32), shape) for s in midstate
+    ])
+    k_arr = jnp.asarray(_K)
+
+    def body(i, carry):
+        st, win = carry
+        a, b, c, d, e, f, g, h = (st[j] for j in range(8))
+        wi = win[0]
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k_arr[i] + wi
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        st = jnp.stack([t1 + s0 + maj, a, b, c, d + t1, e, f, g])
+        w15, w2 = win[1], win[14]
+        ws0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> 3)
+        ws1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> 10)
+        wnew = win[0] + ws0 + win[9] + ws1
+        return st, jnp.concatenate([win[1:], wnew[None]], axis=0)
+
+    st, _ = jax.lax.fori_loop(0, 64, body, (state, window))
+    return tuple(
+        jnp.asarray(m, jnp.uint32) + st[j] for j, m in enumerate(midstate)
+    )
 
 
 def _build_w(tail_words, nonces, nonce_spec):
@@ -268,9 +319,32 @@ def _pallas_kernel(mid_ref, tail_ref, base_ref, out_ref, *, tile_rows: int,
     for j, (widx, shift) in enumerate(nonce_spec):
         byte = (nonces >> jnp.uint32(8 * j)) & jnp.uint32(0xFF)
         w[widx] = w[widx] | (byte << jnp.uint32(shift))
-    digest = _compress_tail(state, w)
+    # always unrolled here: the rolled form would capture the K table as a
+    # pallas_call constant, and Mosaic compiles the flat 64 rounds fast
+    digest = _compress_tail(state, w, unroll=True)
     t = [jnp.uint32(x) for x in (spec.mask0, spec.val0, spec.mask1, spec.val1)]
-    out_ref[0, 0] = _hit_nonce(digest, nonces, *t, spec)
+    ok = (digest[0] & t[0]) == t[1]
+    ok &= (digest[1] & t[2]) == t[3]
+    if spec.charset < 16:
+        nib = (digest[spec.nibble_word] >> jnp.uint32(spec.nibble_shift)) & jnp.uint32(0xF)
+        ok &= nib < jnp.uint32(spec.charset)
+    cand = jnp.where(ok, nonces, jnp.uint32(SENTINEL))
+    # Mosaic has no unsigned reductions (and no scalar bitcasts): flip the
+    # sign bit (order-preserving u32 -> s32 map) on the vector, reduce in
+    # int32, and keep the accumulator in flipped-int32 space — the caller
+    # flips the final scalar back
+    flipped = jax.lax.bitcast_convert_type(
+        cand ^ jnp.uint32(0x80000000), jnp.int32)
+    tile_min = jnp.min(flipped)
+    # one (1,1) SMEM cell min-accumulated across the sequential TPU grid
+    # (a (1,1)-blocked (grid,1) output is not a legal Mosaic block shape)
+    @pl.when(i == 0)
+    def _init():
+        out_ref[0, 0] = tile_min
+
+    @pl.when(i != 0)
+    def _acc():
+        out_ref[0, 0] = jnp.minimum(out_ref[0, 0], tile_min)
 
 
 @functools.partial(jax.jit, static_argnames=("batch", "tile_rows", "nonce_spec", "spec", "interpret"))
@@ -294,15 +368,15 @@ def _pow_search_pallas(midstate, tail_words, nonce_base, batch: int,
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
-        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
-        out_shape=jax.ShapeDtypeStruct((grid, 1), jnp.uint32),
+        out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
         interpret=interpret,
     )(midstate, tail_words, nonce_base.reshape(1))
-    return jnp.min(per_tile)
+    return per_tile[0, 0].astype(jnp.uint32) ^ jnp.uint32(0x80000000)
 
 
 def pow_search_pallas(template: SearchTemplate, spec: TargetSpec,
-                      nonce_base: int, batch: int, tile_rows: int = 32,
+                      nonce_base: int, batch: int, tile_rows: int = 64,
                       interpret: bool = False):
     """Pallas-tiled search; same contract as :func:`pow_search_jnp`."""
     return _pow_search_pallas(

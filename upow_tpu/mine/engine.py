@@ -66,15 +66,31 @@ class MiningJob:
         return check_pow_hash(digest, self.previous_hash, self.difficulty)
 
 
+def _make_dispatcher(job: MiningJob, backend: str) -> Optional[Callable]:
+    """For device backends: dispatch(start, count) -> async device handle.
+
+    The handle resolves via ``int()``; keeping several dispatches in
+    flight hides the host↔device round-trip (which otherwise caps the
+    hash rate — measured ~2x on a tunneled v5e chip)."""
+    if backend not in ("pallas", "jnp"):
+        return None
+    template = sha_kernel.make_template(job.prefix)
+    spec = sha_kernel.target_spec(job.previous_hash, job.difficulty)
+    fn = sha_kernel.pow_search_pallas if backend == "pallas" else sha_kernel.pow_search_jnp
+
+    def dispatch(start: int, count: int):
+        return fn(template, spec, nonce_base=start, batch=count)
+
+    return dispatch
+
+
 def _make_searcher(job: MiningJob, backend: str) -> Callable[[int, int], Optional[int]]:
     """Return search(start, count) -> first hit nonce or None."""
-    if backend in ("pallas", "jnp"):
-        template = sha_kernel.make_template(job.prefix)
-        spec = sha_kernel.target_spec(job.previous_hash, job.difficulty)
-        fn = sha_kernel.pow_search_pallas if backend == "pallas" else sha_kernel.pow_search_jnp
+    dispatch = _make_dispatcher(job, backend)
+    if dispatch is not None:
 
         def search(start: int, count: int) -> Optional[int]:
-            hit = int(fn(template, spec, nonce_base=start, batch=count))
+            hit = int(dispatch(start, count))
             return None if hit == int(sha_kernel.SENTINEL) else hit
 
         return search
@@ -124,11 +140,40 @@ def mine(job: MiningJob, backend: str = "jnp", *, start: int = 0,
     multiple chips/hosts (the reference's worker striding, miner.py:140-148,
     without the per-nonce interleave that would defeat batching).
     """
-    search = _make_searcher(job, backend)
     stride_end = min(stride_end, MAX_SEARCH_END)
     t0 = time.time()
     tried = 0
     cursor = start
+
+    dispatch = _make_dispatcher(job, backend)
+    if dispatch is not None:
+        # Pipelined device rounds: keep `depth` dispatches in flight so the
+        # chip never idles while the host blocks on a result.  A hit wastes
+        # at most the in-flight rounds (already dispatched) — negligible
+        # against the ~2x throughput the overlap buys on a tunneled chip.
+        depth = 2
+        inflight = []  # (handle, base, count)
+        while cursor < stride_end or inflight:
+            while len(inflight) < depth and cursor < stride_end:
+                count = min(batch, stride_end - cursor)
+                inflight.append((dispatch(cursor, count), cursor, count))
+                cursor += count
+            handle, _, count = inflight.pop(0)
+            hit = int(handle)
+            tried += count
+            if hit != int(sha_kernel.SENTINEL):
+                if job.check(hit):
+                    return MineResult(hit, tried, time.time() - t0)
+                raise AssertionError(
+                    f"backend {backend} returned nonce {hit} failing host check")
+            elapsed = time.time() - t0
+            if progress is not None:
+                progress(tried, elapsed)
+            if elapsed > ttl:
+                break
+        return MineResult(None, tried, time.time() - t0)
+
+    search = _make_searcher(job, backend)
     while cursor < stride_end:
         count = min(batch, stride_end - cursor)
         hit = search(cursor, count)
