@@ -27,14 +27,14 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402  (import after env setup)
 
 jax.config.update("jax_platforms", "cpu")
-# Persistent compilation cache: the P-256 verify ladder is a large program
-# whose XLA:CPU compile dominates suite time; cache it across runs.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"),
-)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 
-sys.path.insert(0, os.path.dirname(__file__))
-sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))  # repo root, for bare `pytest`
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+# Persistent compilation cache (keyed per host CPU — foreign AOT entries
+# mis-execute): the P-256 verify ladder is a large program whose XLA:CPU
+# compile dominates suite time; cache it across runs.
+from upow_tpu import compile_cache  # noqa: E402
+
+compile_cache.enable(
+    os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"))
+
+sys.path.insert(0, os.path.dirname(__file__))  # for `import ref_loader`

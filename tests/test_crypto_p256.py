@@ -7,6 +7,7 @@ verdicts — including adversarial/invalid signatures (the consensus
 surface: transaction_input.py:100-109 decides block validity).
 """
 
+import os
 import random
 
 import numpy as np
@@ -182,3 +183,39 @@ def test_verify_batch_valid_and_invalid():
 
 def test_verify_batch_empty():
     assert p256.verify_batch([], [], []).shape == (0,)
+
+
+@pytest.mark.skipif(not os.environ.get("UPOW_SLOW_TESTS"),
+                    reason="pallas-interpret ladder is a ~2 min compile; "
+                           "set UPOW_SLOW_TESTS=1 to include")
+def test_pallas_ladder_matches_host():
+    """The VMEM-resident Pallas verify kernel (TPU production path) in
+    interpret mode against host ECDSA, valid + invalid lanes."""
+    msgs, sigs, pubs = [], [], []
+    for i in range(8):
+        d, pub = curve.keygen(rng=5000 + i)
+        m = i.to_bytes(4, "big") * 4
+        r, s = curve.sign(m, d)
+        if i % 3 == 2:
+            s = (s + 1) % CURVE_N
+        msgs.append(m)
+        sigs.append((r, s))
+        pubs.append(pub)
+    msgs, sigs, pubs = msgs * 16, sigs * 16, pubs * 16
+    import hashlib
+
+    digests = [hashlib.sha256(m).digest() for m in msgs]
+    orig = p256._verify_device_pallas
+
+    def interp(*a, **kw):
+        kw["interpret"] = True
+        return orig(*a, **kw)
+
+    try:
+        p256._verify_device_pallas = interp
+        got = p256.verify_batch_prehashed(
+            digests, sigs, pubs, pad_block=128, backend="pallas")
+    finally:
+        p256._verify_device_pallas = orig
+    want = [curve.verify(sig, m, pk) for sig, m, pk in zip(sigs, msgs, pubs)]
+    assert list(got) == want

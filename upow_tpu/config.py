@@ -33,6 +33,10 @@ class DeviceConfig:
     search_batch: int = 1 << 24     # nonces per device dispatch
     verify_pad_block: int = 128     # lane padding for the P-256 kernel
     mesh_devices: int = 0           # 0 = all visible devices
+    utxo_index: bool = False        # device-resident UTXO membership
+                                    # prefilter on block accept (worth it
+                                    # with a real accelerator; on a CPU
+                                    # node sqlite is already fast)
 
     def resolve_search_backend(self, platform: str) -> str:
         if self.search_backend != "auto":
@@ -49,6 +53,7 @@ class NodeConfig:
     peers_file: str = "nodes.json"
     ip_config_file: str = "ip_config.json"
     self_url: str = ""              # discovered from first request if empty
+    trust_proxy_headers: bool = False  # honour X-Forwarded-For/X-Real-IP
     max_peers: int = 100            # nodes_manager.py:26
     active_within: int = 7 * 86400  # peer considered active (nodes_manager.py:24)
     prune_after: int = 90 * 86400   # forget peers silent this long (:25)

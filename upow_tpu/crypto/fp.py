@@ -47,7 +47,8 @@ class FieldSpec(NamedTuple):
     """Host-side constants for one prime field."""
 
     p: int
-    p_col: np.ndarray          # (21, 1) int32 — broadcastable limb column
+    p_limbs: tuple             # 21 Python-int limbs (scalar constants only:
+                               # non-scalar closures are illegal in Pallas)
     pinv: int                  # -p^-1 mod 2^13
     r_mod_p: int               # R mod p  (Montgomery form of 1)
     r2_mod_p: int              # R^2 mod p
@@ -56,7 +57,7 @@ class FieldSpec(NamedTuple):
 def make_field(p: int) -> FieldSpec:
     return FieldSpec(
         p=p,
-        p_col=int_to_limbs(p).reshape(NUM_LIMBS, 1),
+        p_limbs=tuple(int(x) for x in int_to_limbs(p)),
         pinv=(-pow(p, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS),
         r_mod_p=(1 << R_BITS) % p,
         r2_mod_p=pow(1 << R_BITS, 2, p),
@@ -127,11 +128,13 @@ def from_ints(xs, fs: FieldSpec) -> FE:
 
 
 def const(x: int, n: int, bound: int) -> FE:
-    """Broadcast one host int (< bound) to a (21, N) batch."""
+    """Broadcast one host int (< bound) to a (21, N) batch.
+
+    Built from scalar fills (not a closed-over (21, 1) array) so the same
+    code is legal inside a Pallas kernel."""
+    limbs = int_to_limbs(x)
     return FE(
-        jnp.broadcast_to(
-            jnp.asarray(int_to_limbs(x).reshape(NUM_LIMBS, 1)), (NUM_LIMBS, n)
-        ),
+        jnp.stack([jnp.full((n,), int(l), dtype=jnp.int32) for l in limbs]),
         bound,
     )
 
@@ -189,11 +192,12 @@ def mont_mul(a: FE, b: FE, fs: FieldSpec) -> FE:
         t = t.at[i:i + L].add(a.arr[i] * b.arr)
     t = _sweep(t, 3)
     # Montgomery rounds: zero the bottom L limbs; the single-limb carry per
-    # round keeps m exact (t[i] ≡ value/b^i mod b at round i)
-    p_col = jnp.asarray(fs.p_col)
+    # round keeps m exact (t[i] ≡ value/b^i mod b at round i).  p's limbs
+    # enter as scalar constants (Pallas-legal; see FieldSpec.p_limbs).
     for i in range(L):
         m = (t[i] * fs.pinv) & LIMB_MASK
-        t = t.at[i:i + L].add(m * p_col)
+        mp = jnp.stack([m * pl for pl in fs.p_limbs])
+        t = t.at[i:i + L].add(mp)
         t = t.at[i + 1].add(t[i] >> LIMB_BITS)
     out = _sweep(t[L:], 3)
     return FE(out, a.bound * b.bound // (1 << R_BITS) + 2 * fs.p)

@@ -62,7 +62,8 @@ class Node:
     def __init__(self, config: Optional[Config] = None):
         self.config = config or Config()
         setup_logging(self.config.log)
-        self.state = ChainState(self.config.node.db_path or None)
+        self.state = ChainState(self.config.node.db_path or None,
+                                device_index=self.config.device.utxo_index)
         self.manager = BlockManager(
             self.state, sig_backend=self.config.device.sig_backend)
         self.peers = PeerBook(self.config.node)
@@ -73,6 +74,7 @@ class Node:
         self.tx_cache: deque = deque(maxlen=100)
         self._last_mempool_clean = 0
         self._background: set = set()
+        self._http_session = None  # shared gossip/RPC session, lazy
         self.ws_hub = None  # set by ws.attach(...) when enabled
         self.app = self._build_app()
 
@@ -86,18 +88,38 @@ class Node:
     async def close(self) -> None:
         for task in list(self._background):
             task.cancel()
+        if self._http_session is not None and not self._http_session.closed:
+            await self._http_session.close()
         self.state.close()
 
+    def _session(self):
+        """Shared aiohttp session for all outbound RPC (one connection
+        pool per process, not one per gossip target per message)."""
+        import aiohttp
+
+        if self._http_session is None or self._http_session.closed:
+            self._http_session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=30))
+        return self._http_session
+
     @staticmethod
-    def _client_ip(request: web.Request) -> str:
-        xff = request.headers.get("x-forwarded-for", "")
-        if xff:
-            return xff.split(",")[0].strip()
-        real = request.headers.get("x-real-ip")
-        if real:
-            return real
+    def _peer_ip(request: web.Request) -> str:
         peername = request.transport.get_extra_info("peername") if request.transport else None
         return peername[0] if peername else ""
+
+    def _client_ip(self, request: web.Request) -> str:
+        """Proxy headers are only trusted behind a proxy (config flag):
+        the reference always honours X-Forwarded-For (main.py:375-390)
+        because it assumes the NGINX.md deployment, which lets any direct
+        client spoof its way past the IP filter."""
+        if self.config.node.trust_proxy_headers:
+            xff = request.headers.get("x-forwarded-for", "")
+            if xff:
+                return xff.split(",")[0].strip()
+            real = request.headers.get("x-real-ip")
+            if real:
+                return real
+        return self._peer_ip(request)
 
     async def _params(self, request: web.Request) -> dict:
         """Merge query params with a JSON body (reference Body(False))."""
@@ -119,18 +141,15 @@ class Node:
         self_base = _normalize(self.self_url)
         ignore_base = _normalize(ignore_url or "")
         aws = []
-        ifaces = []
+        session = self._session()
         for node_url in nodes if nodes is not None else self.peers.propagate_nodes():
-            iface = NodeInterface(node_url, self.config.node)
+            iface = NodeInterface(node_url, self.config.node, session=session)
             if iface.base_url in (self_base, ignore_base):
                 continue
             aws.append(iface.request(path, args, self_base))
-            ifaces.append(iface)
         for resp in await asyncio.gather(*aws, return_exceptions=True):
             if isinstance(resp, Exception):
                 log.debug("propagate error: %s", resp)
-        for iface in ifaces:
-            await iface.close()
 
     async def _propagate_old_transactions(self) -> None:
         txs = await self.state.get_need_propagate_transactions()
@@ -148,7 +167,8 @@ class Node:
                 {"ok": False, "error": "Access forbidden."}, status=403)
         normalized = re.sub("/+", "/", request.path) or "/"
         if normalized != request.path:
-            raise web.HTTPFound(normalized)
+            query = request.rel_url.query_string
+            raise web.HTTPFound(normalized + ("?" + query if query else ""))
         if normalized != "/" and not self.ip_filter.allowed(
                 client_ip, endpoint=normalized):
             return web.json_response(
@@ -160,8 +180,15 @@ class Node:
             self.peers.add(sender)
 
         host = request.host.split(":")[0] if request.host else ""
+        # Hardening divergence: the reference gates this custodial endpoint
+        # on the attacker-controlled Host header (main.py:315-322, safe
+        # only behind the NGINX.md proxy).  Gate on the client IP — the
+        # socket peer, or the proxy-reported address when
+        # trust_proxy_headers says the proxy is trusted (otherwise a
+        # proxied deployment would see every client as 127.0.0.1).
+        client_ip2 = self._client_ip(request)
         if normalized == "/send_to_address" and not (
-                is_local_ip(host) or host == "localhost"):
+                client_ip2 and is_local_ip(client_ip2)):
             return web.json_response(
                 {"ok": False, "error": "Access forbidden. This endpoint can "
                  "only be accessed from localhost."}, status=403)
@@ -193,12 +220,10 @@ class Node:
             seeds = self.peers.recent_nodes()
             if not seeds:
                 return
-            iface = NodeInterface(seeds[0], self.config.node)
-            try:
-                for url in await iface.get_nodes():
-                    self.peers.add(url)
-            finally:
-                await iface.close()
+            iface = NodeInterface(seeds[0], self.config.node,
+                                  session=self._session())
+            for url in await iface.get_nodes():
+                self.peers.add(url)
             self.peers.remove(self.self_url)
             await self.propagate("add_node", {"url": self.self_url})
         except Exception as e:
@@ -417,6 +442,7 @@ class Node:
         rows = await self.state.get_ballots(
             "inodes_ballot", inode, offset=offset, limit=limit)
         by_validator: dict = {}
+        stakes: dict = {}  # one stake computation per distinct validator
         for row in rows:
             ent = by_validator.setdefault(row["voter"], {
                 "validator": row["voter"], "vote": []})
@@ -426,8 +452,10 @@ class Node:
                 "tx_hash": row["tx_hash"],
                 "index": row["index"],
             })
-            ent["totalStake"] = str(await self.state.get_validators_stake(
-                row["voter"], check_pending_txs=True))
+            if row["voter"] not in stakes:
+                stakes[row["voter"]] = str(await self.state.get_validators_stake(
+                    row["voter"], check_pending_txs=True))
+            ent["totalStake"] = stakes[row["voter"]]
         return web.json_response(list(by_validator.values()))
 
     async def h_get_delegates_info(self, request: web.Request) -> web.Response:
@@ -563,14 +591,12 @@ class Node:
         if self.peers.contains(url):
             return web.json_response(
                 {"ok": False, "error": "Node already present"})
-        iface = NodeInterface(url, self.config.node)
+        iface = NodeInterface(url, self.config.node, session=self._session())
         try:
             await iface.get("")
         except Exception:
             return web.json_response(
                 {"ok": False, "error": "Could not add node"})
-        finally:
-            await iface.close()
         self._spawn(self.propagate("add_node", {"url": url}, ignore_url=url))
         self.peers.add(url)
         return web.json_response({"ok": True, "result": "Node added"})
@@ -712,7 +738,7 @@ class Node:
             if not nodes:
                 return "No nodes found."
             node_url = random.choice(nodes)
-        iface = NodeInterface(node_url, cfg)
+        iface = NodeInterface(node_url, cfg, session=self._session())
         try:
             _, last_block = await self.manager.calculate_difficulty()
             starting_from = i = await self.state.get_next_block_id()

@@ -141,15 +141,18 @@ class NodeInterface:
         self.url = self.base_url
         self.cfg = cfg or NodeConfig()
         self._session = session
+        self._own_session = session is None  # close() only closes what we made
 
     async def _get_session(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
             self._session = aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=30))
+            self._own_session = True
         return self._session
 
     async def close(self) -> None:
-        if self._session is not None and not self._session.closed:
+        if (self._own_session and self._session is not None
+                and not self._session.closed):
             await self._session.close()
 
     async def _read_capped(self, resp: aiohttp.ClientResponse) -> dict:
@@ -162,10 +165,19 @@ class NodeInterface:
 
     async def request(self, path: str, args: dict,
                       sender_node: str = "") -> dict:
+        """Wire-compatible RPC: POST json for push_block/push_tx, GET with
+        query params for everything else (reference
+        nodes_manager.py:192-209) — so e.g. gossiped ``add_node`` lands on
+        peers' GET routes."""
         session = await self._get_session()
         headers = {"Sender-Node": sender_node} if sender_node else {}
-        async with session.post(f"{self.base_url}/{path}", json=args,
-                                headers=headers) as resp:
+        if path in ("push_block", "push_tx"):
+            async with session.post(f"{self.base_url}/{path}", json=args,
+                                    headers=headers) as resp:
+                return await self._read_capped(resp)
+        params = {k: str(v) for k, v in args.items()}
+        async with session.get(f"{self.base_url}/{path}", params=params,
+                               headers=headers) as resp:
             return await self._read_capped(resp)
 
     async def get(self, path: str, params: Optional[dict] = None,

@@ -134,7 +134,8 @@ _INPUT_TABLE = {
 class ChainState:
     """One chain's durable state.  ``path=None`` -> in-memory (tests)."""
 
-    def __init__(self, path: Optional[str] = None):
+    def __init__(self, path: Optional[str] = None,
+                 device_index: bool = False):
         self.path = path or ":memory:"
         self.db = sqlite3.connect(self.path)
         self.db.row_factory = sqlite3.Row
@@ -147,6 +148,40 @@ class ChainState:
         self.emission_path = (
             os.path.splitext(path)[0] + ".emission.json" if path else None
         )
+        # optional device-resident membership prefilter per UTXO table
+        # (SURVEY.md §2.2; the block-accept hot path's spend check)
+        self._dev_index: Optional[Dict[str, object]] = None
+        if device_index:
+            self.enable_device_index()
+
+    # ------------------------------------------------------ device index --
+    def enable_device_index(self) -> None:
+        """Mirror every UTXO-class table into a :class:`DeviceUtxoIndex`.
+
+        Maintained incrementally by the output add/remove paths; bulk
+        operations (reorg rollback, full replay) rebuild from the tables
+        — the index is reconstructible at any height, which is its
+        checkpoint/resume story."""
+        from .device_index import DeviceUtxoIndex
+
+        self._dev_index = {}
+        for table in ("unspent_outputs",) + _GOV_TABLES:
+            rows = self.db.execute(
+                f"SELECT tx_hash, idx FROM {table}").fetchall()
+            self._dev_index[table] = DeviceUtxoIndex(
+                (r["tx_hash"], r["idx"]) for r in rows)
+
+    def _index_add(self, table: str, outpoints) -> None:
+        if self._dev_index is not None:
+            self._dev_index[table].add(outpoints)
+
+    def _index_remove(self, table: str, outpoints) -> None:
+        if self._dev_index is not None:
+            self._dev_index[table].remove(outpoints)
+
+    def _index_rebuild(self) -> None:
+        if self._dev_index is not None:
+            self.enable_device_index()
 
     def close(self):
         self.db.close()
@@ -160,6 +195,7 @@ class ChainState:
             self.db.commit()
         except BaseException:
             self.db.rollback()
+            self._index_rebuild()  # undo any index updates the txn made
             raise
 
     # ------------------------------------------------------------- blocks --
@@ -256,6 +292,7 @@ class ChainState:
         )
         self.db.execute("DELETE FROM blocks WHERE id >= ?", (from_block_id,))
         self.db.commit()
+        self._index_rebuild()  # reorgs are rare; a bulk resync is ms
 
     async def _restore_spent_outputs(self, inputs: List[TxInput]) -> None:
         """Re-materialize spent outputs by decoding their source txs."""
@@ -514,6 +551,7 @@ class ChainState:
                         " amount) VALUES (?,?,?,?)",
                         (h, index, out.address, out.amount),
                     )
+                self._index_add(table, [(h, index)])
 
     async def remove_outputs(self, txs: Sequence[AnyTx]) -> None:
         """Spend inputs from the table their tx type targets
@@ -526,6 +564,7 @@ class ChainState:
                 f"DELETE FROM {table} WHERE tx_hash = ? AND idx = ?",
                 [(i.tx_hash, i.index) for i in tx.inputs],
             )
+            self._index_remove(table, [i.outpoint for i in tx.inputs])
 
     async def get_unspent_outpoints(self, table: str = "unspent_outputs") -> set:
         rows = self.db.execute(f"SELECT tx_hash, idx FROM {table}").fetchall()
@@ -536,10 +575,14 @@ class ChainState:
         """Batched membership test: one row-value IN query per 400 outpoints
         instead of a query per outpoint — an 8k-input block is ~20 queries.
         (The reference does a set-diff against a full-column fetch,
-        manager.py:531-615; the device-index fast path is in
-        ``state/device_index.py``.)"""
+        manager.py:531-615.)  With the device index enabled the whole
+        batch is one ``searchsorted`` dispatch + host-set confirmation of
+        fingerprint hits — no SQL at all on the hot path."""
         if not outpoints:
             return []
+        if self._dev_index is not None and table in self._dev_index:
+            return self._dev_index[table].contains_batch(
+                [tuple(o) for o in outpoints])
         found: set = set()
         CHUNK = 400
         for off in range(0, len(outpoints), CHUNK):
@@ -1085,6 +1128,7 @@ class ChainState:
             await self.add_transaction_outputs([tx])
             await self.remove_outputs([tx])
         self.db.commit()
+        self._index_rebuild()  # replay rewrote the tables wholesale
 
     # ----------------------------------------------------------- emission --
 
