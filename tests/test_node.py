@@ -398,3 +398,106 @@ def test_ws_transaction_broadcast(tmp_path, keys):
         await ws.close()
 
     run_cluster(tmp_path, scenario)
+
+
+def test_three_node_partition_heal(tmp_path, keys):
+    """Three nodes with live gossip: C is partitioned away while A and B
+    extend the chain (gossip keeps A/B converged in real time); C mines
+    its own fork meanwhile.  When the partition heals, C syncs and all
+    three reach identical UTXO fingerprints (VERDICT #9 / SURVEY §4)."""
+
+    async def scenario(cluster):
+        node_a, client_a = await cluster.add_node("a")
+        node_b, client_b = await cluster.add_node("b")
+        node_c, client_c = await cluster.add_node("c")
+        for n in (node_a, node_b, node_c):
+            n.config.node.sync_reorg_window = 4
+
+        # full mesh peer books
+        for i, n in enumerate((node_a, node_b, node_c)):
+            for j in range(3):
+                if j != i:
+                    n.peers.add(cluster.url(j))
+
+        async def converged(nodes, block_id, tries=100):
+            for _ in range(tries):
+                ids = [await n.state.get_next_block_id() for n in nodes]
+                if all(x == block_id for x in ids):
+                    return True
+                await asyncio.sleep(0.1)
+            return False
+
+        # common prefix: mined on A, gossip carries it to B and C
+        for _ in range(5):
+            assert (await mine_via_api(client_a, keys["addr"]))["ok"]
+        assert await converged((node_a, node_b, node_c), 6)
+
+        # partition C: drop it from A/B's books and empty C's own
+        for n in (node_a, node_b):
+            n.peers.remove(cluster.url(2))
+        node_c.peers.remove(cluster.url(0))
+        node_c.peers.remove(cluster.url(1))
+
+        # majority side extends by 2 (A mines, gossip reaches B);
+        # C mines a 1-block fork of its own
+        assert (await mine_via_api(client_a, keys["addr"]))["ok"]
+        assert (await mine_via_api(client_b, keys["addr"]))["ok"]
+        assert await converged((node_a, node_b), 8)
+        assert (await mine_via_api(client_c, keys["addr"]))["ok"]
+        assert await node_c.state.get_next_block_id() == 7
+        a_tip = (await node_a.state.get_last_block())["hash"]
+        assert (await node_c.state.get_last_block())["hash"] != a_tip
+
+        # heal: C relearns a peer and syncs — reorgs onto the longer chain
+        node_c.peers.add(cluster.url(0))
+        res = await (await client_c.get(
+            "/sync_blockchain", params={"node_url": cluster.url(0)})).json()
+        assert res["ok"], res
+        fingerprints = {
+            await n.state.get_unspent_outputs_hash()
+            for n in (node_a, node_b, node_c)
+        }
+        assert len(fingerprints) == 1
+        assert (await node_c.state.get_last_block())["hash"] == a_tip
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_miner_cli_against_node(tmp_path, keys):
+    """The actual miner client (fetch get_mining_info → merkle over
+    pending hashes → search → push_block) against a live node, including
+    a pending transaction it must confirm (VERDICT weak #8: the MES from
+    SURVEY §7.3, previously only exercised by hand)."""
+
+    async def scenario(cluster):
+        from upow_tpu.core import clock
+        from upow_tpu.mine import miner as miner_cli
+
+        node, client = await cluster.add_node("a")
+        node_url = cluster.url(0) + "/"
+
+        loop = asyncio.get_running_loop()
+
+        def mine_once():
+            return miner_cli.run(keys["addr"], node_url, "python",
+                                 batch=1 << 14, ttl=300, once=True)
+
+        # genesis block (free PoW), then fund a pending tx
+        clock.advance(1)
+        assert await loop.run_in_executor(None, mine_once) == 0
+        assert await node.state.get_next_block_id() == 2
+
+        builder = WalletBuilder(node.state)
+        tx = await builder.create_transaction(keys["d"], keys["addr2"], "1.5")
+        resp = await client.get("/push_tx", params={"tx_hex": tx.hex()})
+        assert (await resp.json())["ok"]
+
+        clock.advance(1)
+        assert await loop.run_in_executor(None, mine_once) == 0
+        assert await node.state.get_next_block_id() == 3
+        got = await node.state.get_transaction(tx.hash())
+        assert got is not None
+        bal = await node.state.get_address_balance(keys["addr2"])
+        assert bal == int(Decimal("1.5") * 10**8)
+
+    run_cluster(tmp_path, scenario)

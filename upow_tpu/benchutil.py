@@ -1,0 +1,52 @@
+"""Shared benchmark plumbing for bench.py / bench_suite.py.
+
+Two things both scoreboards need and must agree on:
+
+* :func:`probe_platform` — backend detection that survives the axon TPU
+  tunnel HANGING inside ``jax.devices()`` (observed >500 s with zero
+  CPU; exceptions are the easy case).  The probe runs on a daemon
+  thread; on timeout the caller decides (bench.py re-execs a
+  scrubbed-env CPU child — once a thread is stuck inside the PJRT
+  plugin no in-process fallback is reliable).
+* :func:`python_loop_mhs` — the reference miner's hashlib-per-nonce
+  loop (reference miner.py:83-98), the CPU baseline every
+  ``vs_baseline`` field is computed against.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Optional
+
+
+def probe_platform(timeout: float = 90.0) -> Optional[str]:
+    """Platform string of jax.devices()[0]; None if init hung or failed."""
+    import threading
+
+    import jax
+
+    box: dict = {}
+
+    def probe():
+        try:
+            box["platform"] = jax.devices()[0].platform
+        except Exception as e:
+            box["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout)
+    return box.get("platform")
+
+
+def python_loop_mhs(prefix: bytes, seconds: float = 1.0) -> float:
+    """Reference-shaped loop: one hashlib sha256 per nonce (the
+    difficulty-prefix compare costs nothing next to the hash)."""
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < seconds:
+        for _ in range(2000):
+            hashlib.sha256(prefix + n.to_bytes(4, "little")).hexdigest()
+            n += 1
+    return n / (time.perf_counter() - t0) / 1e6

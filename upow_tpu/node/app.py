@@ -115,7 +115,10 @@ class Node:
         if self.config.node.trust_proxy_headers:
             xff = request.headers.get("x-forwarded-for", "")
             if xff:
-                return xff.split(",")[0].strip()
+                # rightmost entry: the one OUR proxy appended.  Leftmost
+                # is client-supplied under the standard append-style
+                # proxy config and would let anyone spoof 127.0.0.1.
+                return xff.split(",")[-1].strip()
             real = request.headers.get("x-real-ip")
             if real:
                 return real
@@ -186,9 +189,8 @@ class Node:
         # socket peer, or the proxy-reported address when
         # trust_proxy_headers says the proxy is trusted (otherwise a
         # proxied deployment would see every client as 127.0.0.1).
-        client_ip2 = self._client_ip(request)
         if normalized == "/send_to_address" and not (
-                client_ip2 and is_local_ip(client_ip2)):
+                client_ip and is_local_ip(client_ip)):
             return web.json_response(
                 {"ok": False, "error": "Access forbidden. This endpoint can "
                  "only be accessed from localhost."}, status=403)

@@ -1,22 +1,24 @@
-"""Device-resident UTXO membership index (SURVEY.md §2.2, asyncpg row).
+"""Device-resident UTXO membership prefilter (SURVEY.md §2.2).
 
 The block-accept hot path tests every input outpoint against the unspent
-set (reference manager.py:531-615 does per-class SQL set-diffs).  Here the
-common case runs on device: outpoints are fingerprinted to 32 bits
-(first 4 bytes of sha256(tx_hash || index)), kept as ONE sorted int32
-array in HBM, and a whole block's inputs are tested with a single
-``searchsorted`` + gather-compare.
+set (reference manager.py:531-615 does per-class SQL set-diffs).  Here
+outpoints are fingerprinted to 64 bits (first 8 bytes of
+sha256(tx_hash || index)), kept as ONE sorted int64 array in HBM, and a
+whole block's inputs are tested with a single ``searchsorted`` + gather
+compare.
 
 The fingerprint is a *prefilter*, not the consensus decision:
 
-* fingerprint miss  -> outpoint is definitely NOT unspent (exact),
-* fingerprint hit   -> "maybe" — the host double-checks against storage.
+* fingerprint miss -> outpoint is definitely NOT unspent (exact), so
+  double-spend floods and bad forks reject after one device call;
+* fingerprint hit  -> "maybe" — the caller escalates to storage
+  (``ChainState.outpoints_exist`` confirms hits with its batched SQL).
 
-With ~1M UTXOs the false-positive rate is ~0.02% per lookup, so an
-8k-input block escalates a handful of host lookups while the other
-thousands short-circuit on device.  Rebuilds are a numpy sort (ms),
-refreshed per accepted block; the array is reconstructible from storage
-at any height (checkpoint/resume story, SURVEY.md §5).
+Holding only 8 bytes per outpoint host+device-side, the index scales to
+many millions of UTXOs.  Trusting hits outright would be unsound: an
+attacker who grinds ~2^44 hashes finds an outpoint colliding with some
+existing fingerprint, and a false "unspent" verdict is a consensus
+break — hence the escalation, exactly the SURVEY §2.2 design.
 """
 
 from __future__ import annotations
@@ -33,8 +35,9 @@ Outpoint = Tuple[str, int]
 
 def fingerprint(outpoint: Outpoint) -> int:
     tx_hash, index = outpoint
-    digest = hashlib.sha256(bytes.fromhex(tx_hash) + index.to_bytes(1, "little")).digest()
-    return int.from_bytes(digest[:4], "little", signed=True)  # int32 reinterpret
+    digest = hashlib.sha256(
+        bytes.fromhex(tx_hash) + index.to_bytes(2, "little")).digest()
+    return int.from_bytes(digest[:8], "little", signed=True)  # int64
 
 
 @jax.jit
@@ -48,51 +51,52 @@ class DeviceUtxoIndex:
     """Sorted-fingerprint membership prefilter, one per UTXO-class table."""
 
     def __init__(self, outpoints: Iterable[Outpoint] = ()):
-        self._exact = set(outpoints)
+        self._fps = {fingerprint(o) for o in outpoints}
         self._dirty = True
         self._keys = None
 
     def __len__(self):
-        return len(self._exact)
+        return len(self._fps)
 
     def add(self, outpoints: Iterable[Outpoint]) -> None:
-        self._exact.update(outpoints)
+        self._fps.update(fingerprint(o) for o in outpoints)
         self._dirty = True
 
     def remove(self, outpoints: Iterable[Outpoint]) -> None:
-        self._exact.difference_update(outpoints)
+        # NB: a (vanishingly rare) colliding pair would be over-removed;
+        # the escalation to storage keeps that sound — it only costs a
+        # false "maybe-not" turned into a definite miss for the twin.
+        self._fps.difference_update(fingerprint(o) for o in outpoints)
         self._dirty = True
 
     def _device_keys(self):
         if self._dirty:
-            keys = np.fromiter(
-                (fingerprint(o) for o in self._exact), dtype=np.int32,
-                count=len(self._exact),
-            )
+            keys = np.fromiter(iter(self._fps), dtype=np.int64,
+                               count=len(self._fps))
             keys.sort()
             # pad to a non-empty power-of-two length to bound recompiles
             n = max(1, 1 << (len(keys) - 1).bit_length()) if len(keys) else 1
-            pad = np.full(n - len(keys), np.iinfo(np.int32).max, dtype=np.int32)
+            pad = np.full(n - len(keys), np.iinfo(np.int64).max, dtype=np.int64)
             self._keys = jnp.asarray(np.concatenate([keys, pad]))
             self._dirty = False
         return self._keys
 
-    def contains_batch(self, outpoints: Sequence[Outpoint]) -> List[bool]:
-        """Exact membership for a batch: device prefilter + host refinement."""
+    def maybe_contains_batch(self, outpoints: Sequence[Outpoint]) -> np.ndarray:
+        """(N,) bool: False is definitive absence; True means escalate."""
         if not outpoints:
-            return []
+            return np.zeros(0, dtype=bool)
         queries = np.fromiter(
-            (fingerprint(o) for o in outpoints), dtype=np.int32,
+            (fingerprint(o) for o in outpoints), dtype=np.int64,
             count=len(outpoints),
         )
         n = 1 << (len(queries) - 1).bit_length() if len(queries) else 1
         padded = np.concatenate([
-            queries, np.full(n - len(queries), np.iinfo(np.int32).min, np.int32)])
-        maybe = np.asarray(_member_mask(self._device_keys(), jnp.asarray(padded)))[
-            : len(outpoints)]
-        # fingerprint hit -> host-exact confirmation (collisions possible)
-        return [bool(m) and (o in self._exact) for m, o in zip(maybe, outpoints)]
+            queries, np.full(n - len(queries), np.iinfo(np.int64).min, np.int64)])
+        return np.asarray(
+            _member_mask(self._device_keys(), jnp.asarray(padded))
+        )[: len(outpoints)]
 
     def missing(self, outpoints: Sequence[Outpoint]) -> List[Outpoint]:
-        present = self.contains_batch(outpoints)
-        return [o for o, ok in zip(outpoints, present) if not ok]
+        """Outpoints that are definitely absent (no escalation needed)."""
+        maybe = self.maybe_contains_batch(outpoints)
+        return [o for o, m in zip(outpoints, maybe) if not m]

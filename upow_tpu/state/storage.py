@@ -153,6 +153,34 @@ class ChainState:
         self._dev_index: Optional[Dict[str, object]] = None
         if device_index:
             self.enable_device_index()
+        # decoded-mempool cache: several read paths walk every pending tx
+        # (balance/stake with check_pending, builder guards); decoding the
+        # whole mempool hex per call is the reference's O(mempool)
+        # anti-pattern (database.py:1138-1205) — decode once per intake.
+        self._pending_cache: Optional[Dict[str, Tx]] = None
+        self._pending_stamp: tuple = (-1, -1, -1)
+        self._pending_gen = 0  # bumped on every LOCAL mempool mutation
+
+    def _pending_decoded(self) -> Dict[str, Tx]:
+        # (count, max rowid) detects writes from OTHER connections (the
+        # wallet CLI's direct-mempool fallback shares the sqlite file):
+        # inserts bump max rowid, deletes drop the count.  The local
+        # generation counter covers the one combination they miss —
+        # delete-the-newest-then-insert reuses the freed max rowid at an
+        # unchanged count (sqlite rowid reuse without AUTOINCREMENT).
+        r = self.db.execute(
+            "SELECT COUNT(*) AS c, COALESCE(MAX(rowid), 0) AS m"
+            " FROM pending_transactions").fetchone()
+        stamp = (r["c"], r["m"], self._pending_gen)
+        if self._pending_cache is None or self._pending_stamp != stamp:
+            rows = self.db.execute(
+                "SELECT tx_hash, tx_hex FROM pending_transactions").fetchall()
+            self._pending_cache = {
+                row["tx_hash"]: tx_from_hex(row["tx_hex"], check_signatures=False)
+                for row in rows
+            }
+            self._pending_stamp = stamp
+        return self._pending_cache
 
     # ------------------------------------------------------ device index --
     def enable_device_index(self) -> None:
@@ -452,6 +480,7 @@ class ChainState:
             [(i.tx_hash, i.index) for i in tx.inputs],
         )
         self.db.commit()
+        self._pending_gen += 1
 
     async def pending_transaction_exists(self, tx_hash: str) -> bool:
         r = self.db.execute(
@@ -505,11 +534,13 @@ class ChainState:
             self.db.execute(
                 "DELETE FROM pending_transactions WHERE tx_hash = ?", (h,))
         self.db.commit()
+        self._pending_gen += 1
 
     async def remove_pending_transactions(self) -> None:
         self.db.execute("DELETE FROM pending_transactions")
         self.db.execute("DELETE FROM pending_spent_outputs")
         self.db.commit()
+        self._pending_gen += 1
 
     async def get_pending_transactions_count(self) -> int:
         return self.db.execute(
@@ -575,14 +606,26 @@ class ChainState:
         """Batched membership test: one row-value IN query per 400 outpoints
         instead of a query per outpoint — an 8k-input block is ~20 queries.
         (The reference does a set-diff against a full-column fetch,
-        manager.py:531-615.)  With the device index enabled the whole
-        batch is one ``searchsorted`` dispatch + host-set confirmation of
-        fingerprint hits — no SQL at all on the hot path."""
+        manager.py:531-615.)  With the device index enabled, one
+        ``searchsorted`` dispatch rejects definite misses first — a
+        double-spend flood or bad fork costs one device call — and only
+        fingerprint "maybes" escalate to the batched SQL below (a hit is
+        not proof: a ground 64-bit collision must not flip a consensus
+        verdict)."""
         if not outpoints:
             return []
         if self._dev_index is not None and table in self._dev_index:
-            return self._dev_index[table].contains_batch(
+            maybe = self._dev_index[table].maybe_contains_batch(
                 [tuple(o) for o in outpoints])
+            escalate = [o for o, m in zip(outpoints, maybe) if m]
+            confirmed = iter(await self._outpoints_exist_sql(escalate, table))
+            return [bool(m) and next(confirmed) for m in maybe]
+        return await self._outpoints_exist_sql(outpoints, table)
+
+    async def _outpoints_exist_sql(self, outpoints: List[Tuple[str, int]],
+                                   table: str) -> List[bool]:
+        if not outpoints:
+            return []
         found: set = set()
         CHUNK = 400
         for off in range(0, len(outpoints), CHUNK):
@@ -653,10 +696,7 @@ class ChainState:
         balance = sum(i.amount for i in await self.get_spendable_outputs(
             address, check_pending_txs=check_pending_txs))
         if check_pending_txs:
-            rows = self.db.execute(
-                "SELECT tx_hex FROM pending_transactions").fetchall()
-            for r in rows:
-                tx = tx_from_hex(r["tx_hex"], check_signatures=False)
+            for tx in self._pending_decoded().values():
                 for out in tx.outputs:
                     if out.address == address and out.output_type == OutputType.REGULAR:
                         balance += out.amount
@@ -670,9 +710,7 @@ class ChainState:
             address, check_pending_txs=check_pending_txs))
         stake = Decimal(stake) / SMALLEST
         if check_pending_txs:
-            rows = self.db.execute("SELECT tx_hex FROM pending_transactions").fetchall()
-            for r in rows:
-                tx = tx_from_hex(r["tx_hex"], check_signatures=False)
+            for tx in self._pending_decoded().values():
                 for out in tx.outputs:
                     if out.address == address and out.is_stake:
                         stake += Decimal(out.amount) / SMALLEST
@@ -751,19 +789,25 @@ class ChainState:
     async def get_votes_by_voter(self, table: str, voter: str,
                                  check_pending_txs: bool = False) -> List[dict]:
         """Standing votes cast BY ``voter`` (reference database.py:1557-1581
-        get_delegates_spent_votes shape: match on inputs_addresses[idx])."""
+        get_delegates_spent_votes shape: match on inputs_addresses[idx]).
+
+        One JOIN instead of a per-ballot-row transaction fetch (the
+        reference's N+1 shape, flagged in SURVEY §3 hot loops); the voter
+        match stays in Python because inputs_addresses is a JSON array."""
         rows = self.db.execute(
-            f"SELECT g.tx_hash, g.idx, g.address, g.amount FROM {table} g"
+            f"SELECT g.tx_hash, g.idx, g.address, g.amount,"
+            f" t.inputs_addresses FROM {table} g"
+            f" JOIN transactions t ON t.tx_hash = g.tx_hash"
         ).fetchall()
         pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
         out = []
         for r in rows:
             if (r["tx_hash"], r["idx"]) in pending:
                 continue
-            info = await self.get_transaction_info(r["tx_hash"])
-            if info is None or r["idx"] >= len(info["inputs_addresses"]):
+            inputs_addresses = json.loads(r["inputs_addresses"])
+            if r["idx"] >= len(inputs_addresses):
                 continue
-            if info["inputs_addresses"][r["idx"]] != voter:
+            if inputs_addresses[r["idx"]] != voter:
                 continue
             out.append({
                 "tx_hash": r["tx_hash"], "index": r["idx"],
@@ -864,21 +908,14 @@ class ChainState:
 
     async def get_pending_stake_transactions(self, address: str) -> List[Tx]:
         """Pending txs that stake for this address (database.py:1157-1172)."""
-        rows = self.db.execute("SELECT tx_hex FROM pending_transactions").fetchall()
-        out = []
-        for r in rows:
-            tx = tx_from_hex(r["tx_hex"], check_signatures=False)
-            if any(o.address == address and o.is_stake for o in tx.outputs):
-                out.append(tx)
-        return out
+        return [tx for tx in self._pending_decoded().values()
+                if any(o.address == address and o.is_stake for o in tx.outputs)]
 
     async def get_pending_vote_as_delegate_transactions(self, address: str) -> List[Tx]:
         """Pending VOTE_AS_DELEGATE txs whose first input is this address
         (database.py:1174-1187)."""
-        rows = self.db.execute("SELECT tx_hex FROM pending_transactions").fetchall()
         out = []
-        for r in rows:
-            tx = tx_from_hex(r["tx_hex"], check_signatures=False)
+        for tx in self._pending_decoded().values():
             if tx.transaction_type != TransactionType.VOTE_AS_DELEGATE or tx.is_coinbase:
                 continue
             if not tx.inputs:
@@ -938,9 +975,7 @@ class ChainState:
             out[r["address"]] += Decimal(r["amount"]) / SMALLEST
         if check_pending_txs:
             want = set(addresses)
-            for r in self.db.execute(
-                    "SELECT tx_hex FROM pending_transactions").fetchall():
-                tx = tx_from_hex(r["tx_hex"], check_signatures=False)
+            for tx in self._pending_decoded().values():
                 for o in tx.outputs:
                     if o.is_stake and o.address in want:
                         out[o.address] += Decimal(o.amount) / SMALLEST
