@@ -78,6 +78,8 @@ def main() -> int:
                     help="0 = auto (2^28 on tpu, 2^20 on cpu)")
     ap.add_argument("--depth", type=int, default=2,
                     help="pipelined dispatches in flight")
+    ap.add_argument("--trace-dir", default=None,
+                    help="capture a jax.profiler trace of the measurement")
     args = ap.parse_args()
 
     import jax
@@ -132,21 +134,24 @@ def main() -> int:
     # pipelined measurement: keep `depth` dispatches in flight so the chip
     # never idles on the host round-trip (the production engine.mine loop
     # does the same; ~2x on a tunneled chip)
-    t0 = time.perf_counter()
-    hashes = 0
-    base = 0
-    inflight = []
-    while time.perf_counter() - t0 < args.seconds or inflight:
-        while (len(inflight) < max(1, args.depth)
-               and time.perf_counter() - t0 < args.seconds):
-            inflight.append(search(template, spec, nonce_base=base,
-                                   batch=args.batch))
-            base = (base + args.batch) % (1 << 32)
-        if not inflight:  # deadline crossed between the two time checks
-            break
-        _ = int(inflight.pop(0))  # block on the oldest round
-        hashes += args.batch
-    mhs = hashes / (time.perf_counter() - t0) / 1e6
+    from upow_tpu.trace import profile
+
+    with profile(args.trace_dir):
+        t0 = time.perf_counter()
+        hashes = 0
+        base = 0
+        inflight = []
+        while time.perf_counter() - t0 < args.seconds or inflight:
+            while (len(inflight) < max(1, args.depth)
+                   and time.perf_counter() - t0 < args.seconds):
+                inflight.append(search(template, spec, nonce_base=base,
+                                       batch=args.batch))
+                base = (base + args.batch) % (1 << 32)
+            if not inflight:  # deadline crossed between the two time checks
+                break
+            _ = int(inflight.pop(0))  # block on the oldest round
+            hashes += args.batch
+        mhs = hashes / (time.perf_counter() - t0) / 1e6
 
     baseline = _baseline_python_mhs(header.prefix_bytes())
     print(json.dumps({

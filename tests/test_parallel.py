@@ -75,3 +75,28 @@ def test_verify_batch_sharded_matches_unsharded():
     got = p256.verify_batch(msgs, sigs, pubs)
     want = [curve.verify(sig, m, p) for sig, m, p in zip(sigs, msgs, pubs)]
     assert list(got) == want
+
+
+def test_multihost_nonce_plan():
+    """Disjoint exhaustive ranges, deterministic across processes
+    (parallel/multihost.py; the multi-slice mining scale-out plan)."""
+    from upow_tpu.parallel.multihost import (NONCE_SPACE, my_nonce_range,
+                                             plan_nonce_ranges)
+
+    for k in (1, 3, 8, 13):
+        plan = plan_nonce_ranges(k)
+        assert plan[0][0] == 0 and plan[-1][1] == NONCE_SPACE
+        for (a, b), (c, d) in zip(plan, plan[1:]):
+            assert b == c and a < b
+    # single-process: my range is the whole space
+    assert my_nonce_range() == (0, NONCE_SPACE)
+    # sub-ranges work too (delegating a slice of the space to a pod)
+    sub = plan_nonce_ranges(4, 100, 1100)
+    assert sub[0][0] == 100 and sub[-1][1] == 1100
+
+
+def test_multihost_initialize_noop(monkeypatch):
+    from upow_tpu.parallel import multihost
+
+    monkeypatch.delenv("UPOW_COORDINATOR_ADDRESS", raising=False)
+    assert multihost.initialize() is False  # no coordinator configured

@@ -1,0 +1,67 @@
+"""Timing spans + stats registry (upow_tpu/trace.py; SURVEY §5 tracing)."""
+
+from upow_tpu import trace
+
+
+def test_span_stats_accumulate():
+    trace.reset()
+    with trace.span("unit_test_section"):
+        pass
+    with trace.span("unit_test_section"):
+        pass
+    s = trace.stats()["unit_test_section"]
+    assert s["count"] == 2
+    assert s["total_s"] >= 0 and s["max_s"] >= 0
+    trace.reset()
+    assert "unit_test_section" not in trace.stats()
+
+
+def test_span_records_on_exception():
+    trace.reset()
+    try:
+        with trace.span("boom"):
+            raise ValueError("x")
+    except ValueError:
+        pass
+    assert trace.stats()["boom"]["count"] == 1
+
+
+def test_profile_noop_without_dir():
+    with trace.profile(None):
+        x = 1 + 1
+    assert x == 2
+
+
+def test_block_accept_span_fires(tmp_path):
+    """create_block goes through the span (the reference logs every
+    accept, manager.py:732-736)."""
+    import asyncio
+    from decimal import Decimal
+
+    from upow_tpu.core import curve, difficulty, point_to_string
+    from upow_tpu.core.clock import timestamp
+    from upow_tpu.core.header import BlockHeader
+    from upow_tpu.core.merkle import merkle_root
+    from upow_tpu.state import ChainState
+    from upow_tpu.verify import BlockManager
+
+    old = difficulty.START_DIFFICULTY
+    difficulty.START_DIFFICULTY = Decimal("1.0")
+    trace.reset()
+    try:
+        async def main():
+            state = ChainState()
+            manager = BlockManager(state, sig_backend="host")
+            _, pub = curve.keygen(rng=77)
+            header = BlockHeader(
+                previous_hash=(18_884_643).to_bytes(32, "little").hex(),
+                address=point_to_string(pub), merkle_root=merkle_root([]),
+                timestamp=timestamp(), difficulty_x10=10, nonce=0)
+            assert await manager.create_block(header.hex(), [], errors=[])
+            state.close()
+
+        asyncio.run(main())
+        assert trace.stats()["block_accept"]["count"] == 1
+    finally:
+        difficulty.START_DIFFICULTY = old
+        trace.reset()

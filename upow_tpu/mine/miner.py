@@ -84,8 +84,9 @@ def run(address: str, node: str, device: str, batch: int, ttl: float,
         shard: tuple = (0, 1), once: bool = False) -> int:
     backend = select_backend(device)
     i, k = shard
-    lo = NONCE_SPACE * i // k
-    hi = NONCE_SPACE * (i + 1) // k
+    from ..parallel.multihost import plan_nonce_ranges
+
+    lo, hi = plan_nonce_ranges(k)[i]
     print(f"upow_tpu miner: backend={backend} shard={i}/{k} "
           f"nonces=[{lo}, {hi}) node={node}")
     while True:
@@ -136,6 +137,16 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     i, k = (int(x) for x in args.shard.split("/"))
     assert 0 <= i < k, "--shard must be i/k with 0 <= i < k"
+    if (i, k) == (0, 1):
+        # multi-host run (UPOW_COORDINATOR_ADDRESS set): each process
+        # takes its slot in the deterministic nonce plan automatically
+        from ..parallel import multihost
+
+        if multihost.initialize():
+            import jax
+
+            i, k = jax.process_index(), jax.process_count()
+            print(f"distributed mining: process {i}/{k}")
     node = args.node.rstrip("/") + "/"
     return run(args.address, node, args.device, args.batch, args.ttl,
                shard=(i, k), once=args.once)

@@ -6,3 +6,4 @@ from .mesh import (
     pow_search_sharded,
     shard_batch_arrays,
 )
+from .multihost import initialize, my_nonce_range, plan_nonce_ranges

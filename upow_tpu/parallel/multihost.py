@@ -1,0 +1,89 @@
+"""Multi-host / multi-slice scale-out (SURVEY.md §2.3 distributed plane).
+
+The reference scales mining with one OS process per core, each striding
+the nonce space (miner.py:126-156), and scales the network over
+HTTP/JSON gossip.  The TPU-native equivalents here:
+
+* **Within a slice** — :mod:`.mesh` already handles it: one jitted
+  program over an ICI mesh, ``pmin`` for the hit reduction.  No code in
+  this module runs per-nonce.
+* **Across slices / hosts (DCN)** — mining needs NO collectives at all:
+  the coordinator hands each slice a disjoint nonce range and the first
+  hit wins via the ordinary chain plane (push_block).  That is what
+  :func:`plan_nonce_ranges` computes, deterministically, from the
+  process topology — the multi-slice analog of the reference's
+  worker-index striding.
+* **Process bring-up** — :func:`initialize` wraps
+  ``jax.distributed.initialize`` with the env-var conventions of TPU
+  pods, and is a no-op in single-process runs so every caller can use
+  it unconditionally.
+
+Sequence/tensor/pipeline parallelism have no analog in this workload —
+there are no tensors to shard; the only parallel axes are the nonce
+space and the per-signature verify batch (both embarrassingly
+parallel).  Stated here so nobody goes looking for a hollow SP layer.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+from ..mine.engine import NONCE_SPACE
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """Bring up jax.distributed for a multi-host run; no-op if the run
+    is single-process (no coordinator configured anywhere).
+
+    Returns True when distributed mode is active."""
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "UPOW_COORDINATOR_ADDRESS")
+    if coordinator_address is None and num_processes is None:
+        # single host, nothing to do — jax.process_count() stays 1
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    except RuntimeError as e:
+        if jax.process_count() > 1:
+            return True  # already initialized (e.g. by the launcher)
+        # a configured-but-failed bring-up must be LOUD: silently falling
+        # back to single-process mode would have every host mine the full
+        # nonce space (duplicate work, no error anywhere)
+        raise RuntimeError(
+            f"jax.distributed.initialize failed for coordinator "
+            f"{coordinator_address!r}: {e}") from e
+
+
+def plan_nonce_ranges(num_processes: int,
+                      lo: int = 0, hi: int = NONCE_SPACE
+                      ) -> List[Tuple[int, int]]:
+    """Disjoint, exhaustive [lo, hi) ranges, one per process.
+
+    Deterministic so every process computes the same plan with no
+    communication — the coordinator role is just "everyone runs this".
+    Contiguous blocks (not the reference's per-nonce interleave,
+    miner.py:140-148) keep each device round a single iota."""
+    assert 0 <= lo < hi <= NONCE_SPACE
+    span = hi - lo
+    return [
+        (lo + span * i // num_processes, lo + span * (i + 1) // num_processes)
+        for i in range(num_processes)
+    ]
+
+
+def my_nonce_range(lo: int = 0, hi: int = NONCE_SPACE) -> Tuple[int, int]:
+    """This process's range under the global plan (jax.process_index)."""
+    import jax
+
+    plan = plan_nonce_ranges(max(1, jax.process_count()), lo, hi)
+    return plan[jax.process_index()]

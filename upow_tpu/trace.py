@@ -1,0 +1,82 @@
+"""Timing spans + JAX profiler hooks (SURVEY.md §5 tracing gap).
+
+The reference's only instrumentation is ad-hoc ``perf_counter`` prints
+around block creation (manager.py:655, 732-736) and UTXO deletes
+(database.py:628-663).  Here one tiny module serves both roles:
+
+* :func:`span` — context manager that logs the wall time of a named
+  section and feeds a process-wide stats registry (count / total /
+  max), exposed via :func:`stats` for the node's health surface.
+* :func:`profile` — wraps ``jax.profiler.trace`` so a kernel section
+  can be captured for xprof/tensorboard when a trace dir is configured;
+  a no-op otherwise (profiling must never take the node down).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from .logger import get_logger
+
+log = get_logger("trace")
+
+_stats: Dict[str, dict] = defaultdict(
+    lambda: {"count": 0, "total_s": 0.0, "max_s": 0.0})
+
+
+@contextmanager
+def span(name: str, level: str = "debug", **fields):
+    """Time a section; log '<name> took T s' plus any context fields."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        s = _stats[name]
+        s["count"] += 1
+        s["total_s"] += dt
+        s["max_s"] = max(s["max_s"], dt)
+        extra = "".join(f" {k}={v}" for k, v in fields.items())
+        getattr(log, level, log.debug)("%s took %.3fs%s", name, dt, extra)
+
+
+def stats() -> Dict[str, dict]:
+    """Snapshot of span statistics: {name: {count, total_s, max_s}}."""
+    return {k: dict(v) for k, v in _stats.items()}
+
+
+def reset() -> None:
+    _stats.clear()
+
+
+@contextmanager
+def profile(trace_dir: Optional[str] = None):
+    """Capture a JAX profiler trace into ``trace_dir`` (xprof format).
+
+    No-op when trace_dir is falsy or the profiler is unavailable.  Only
+    profiler SETUP/TEARDOWN failures are swallowed — exceptions raised
+    by the caller's body must propagate untouched (a yield inside a
+    try/except would eat them and then crash contextlib)."""
+    if not trace_dir:
+        yield
+        return
+    ctx = None
+    try:
+        import jax
+
+        ctx = jax.profiler.trace(trace_dir)
+        ctx.__enter__()
+    except Exception as e:  # profiling must never break the caller
+        log.warning("jax profiler unavailable: %s", e)
+        ctx = None
+    try:
+        yield
+    finally:
+        if ctx is not None:
+            try:
+                ctx.__exit__(None, None, None)
+            except Exception as e:
+                log.warning("jax profiler teardown failed: %s", e)

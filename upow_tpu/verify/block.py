@@ -221,7 +221,15 @@ class BlockManager:
                            last_block: Optional[dict] = None,
                            errors: Optional[list] = None) -> bool:
         """Validate + apply one mined block (manager.py:650-757)."""
+        from ..trace import span
+
         errors = errors if errors is not None else []
+        with span("block_accept", level="info", txs=len(transactions)):
+            return await self._create_block_timed(
+                block_content, transactions, last_block, errors)
+
+    async def _create_block_timed(self, block_content, transactions,
+                                  last_block, errors) -> bool:
         self.invalidate_difficulty()
         difficulty, last_block = await self.calculate_difficulty()
         block_no = (last_block["id"] + 1) if last_block else 1
