@@ -314,3 +314,41 @@ def test_device_utxo_index_matches_sql(keys, monkeypatch):
     assert on == off
     assert on[0] is True          # the double spend was rejected both ways
     assert on[2] == [False, True, False]
+
+
+def test_reindex_tool(tmp_path, keys):
+    """python -m upow_tpu.state.reindex --check: the replay oracle as an
+    operator tool (reference create_unspent_outputs.py)."""
+
+    async def build():
+        state = ChainState(str(tmp_path / "chain.sqlite"))
+        manager = BlockManager(state, sig_backend="host")
+        await mine_and_accept(manager, state, keys["a1"], ts_offset=-3)
+        tx = await make_send(state, keys["d1"], keys["a1"], keys["a2"],
+                             1 * SMALLEST)
+        await mine_and_accept(manager, state, keys["a1"], txs=[tx],
+                              ts_offset=-1)
+        fp = await state.get_unspent_outputs_hash()
+        state.close()
+        return fp
+
+    fp = run(build())
+    from upow_tpu.state.reindex import amain
+
+    assert run(amain(["--db", str(tmp_path / "chain.sqlite"), "--check"])) == 0
+    # the check must not have touched the live db
+    async def fingerprint():
+        state = ChainState(str(tmp_path / "chain.sqlite"))
+        out = await state.get_unspent_outputs_hash()
+        state.close()
+        return out
+
+    assert run(fingerprint()) == fp
+    # a corrupted UTXO table is detected
+    import sqlite3
+
+    db = sqlite3.connect(str(tmp_path / "chain.sqlite"))
+    db.execute("DELETE FROM unspent_outputs")
+    db.commit()
+    db.close()
+    assert run(amain(["--db", str(tmp_path / "chain.sqlite"), "--check"])) == 1

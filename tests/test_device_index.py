@@ -1,0 +1,53 @@
+"""DeviceUtxoIndex: prefilter semantics, multiset collision safety
+(upow_tpu/state/device_index.py; SURVEY §2.2, VERDICT weak #5)."""
+
+import numpy as np
+
+from upow_tpu.state.device_index import DeviceUtxoIndex, fingerprint
+
+
+def _op(i: int, idx: int = 0):
+    return (i.to_bytes(32, "big").hex(), idx)
+
+
+def test_prefilter_membership_and_updates():
+    ops = [_op(1), _op(2), _op(3, 254)]
+    idx = DeviceUtxoIndex(ops[:2])
+    assert list(idx.maybe_contains_batch(ops)) == [True, True, False]
+    idx.add([ops[2]])
+    assert list(idx.maybe_contains_batch(ops)) == [True, True, True]
+    idx.remove([ops[0]])
+    assert list(idx.maybe_contains_batch(ops)) == [False, True, True]
+    assert idx.missing(ops) == [ops[0]]
+    assert len(idx) == 2
+
+
+def test_empty_and_large_batches():
+    idx = DeviceUtxoIndex()
+    assert idx.maybe_contains_batch([]).shape == (0,)
+    ops = [_op(i) for i in range(1000)]
+    idx.add(ops)
+    mask = idx.maybe_contains_batch(ops + [_op(10_000)])
+    assert mask[:1000].all() and not mask[1000]
+
+
+def test_collision_twin_not_over_removed(monkeypatch):
+    """Two live outpoints sharing a fingerprint: spending one must NOT
+    make the prefilter report the survivor as definitely absent (that
+    would reject a valid block)."""
+    import upow_tpu.state.device_index as di
+
+    monkeypatch.setattr(di, "fingerprint", lambda o: 42)  # force collision
+    idx = di.DeviceUtxoIndex([_op(1), _op(2)])
+    idx.remove([_op(1)])
+    # the survivor still fingerprint-hits (escalation decides exactness)
+    assert list(idx.maybe_contains_batch([_op(2)])) == [True]
+    idx.remove([_op(2)])
+    assert list(idx.maybe_contains_batch([_op(2)])) == [False]
+
+
+def test_fingerprint_is_stable_and_signed32():
+    fp = fingerprint(_op(7, 3))
+    assert fp == fingerprint(_op(7, 3))
+    assert -(1 << 31) <= fp < (1 << 31)
+    assert fingerprint(_op(7, 4)) != fp

@@ -288,10 +288,16 @@ class Node:
 
     # --------------------------------------------------------- handlers ---
     async def h_root(self, request: web.Request) -> web.Response:
+        """Health probe (reference main.py:266-275) + additive timing
+        stats from the span registry (trace.py) — same shape the
+        reference's required keys take, extra key ignored by peers."""
+        from ..trace import stats
+
         fingerprint = await self.state.get_unspent_outputs_hash()
         return web.json_response({
             "ok": True, "version": VERSION,
             "unspent_outputs_hash": fingerprint,
+            "timings": stats(),
         })
 
     async def h_push_tx(self, request: web.Request) -> web.Response:

@@ -2,10 +2,12 @@
 
 The block-accept hot path tests every input outpoint against the unspent
 set (reference manager.py:531-615 does per-class SQL set-diffs).  Here
-outpoints are fingerprinted to 64 bits (first 8 bytes of
-sha256(tx_hash || index)), kept as ONE sorted int64 array in HBM, and a
+outpoints are fingerprinted to 32 bits (first 4 bytes of
+sha256(tx_hash || index)), kept as ONE sorted int32 array in HBM, and a
 whole block's inputs are tested with a single ``searchsorted`` + gather
-compare.
+compare.  (int32, not int64: without jax_enable_x64 JAX silently
+downcasts 64-bit arrays, which would truncate AFTER the host sort and
+hand searchsorted an unsorted array.)
 
 The fingerprint is a *prefilter*, not the consensus decision:
 
@@ -14,16 +16,17 @@ The fingerprint is a *prefilter*, not the consensus decision:
 * fingerprint hit  -> "maybe" — the caller escalates to storage
   (``ChainState.outpoints_exist`` confirms hits with its batched SQL).
 
-Holding only 8 bytes per outpoint host+device-side, the index scales to
-many millions of UTXOs.  Trusting hits outright would be unsound: an
-attacker who grinds ~2^44 hashes finds an outpoint colliding with some
-existing fingerprint, and a false "unspent" verdict is a consensus
-break — hence the escalation, exactly the SURVEY §2.2 design.
+Holding only 4 bytes per outpoint host+device-side, the index scales to
+many millions of UTXOs.  Trusting hits outright would be unsound — a
+32-bit collision (trivially grindable, and ~0.02%/query by chance at
+1M UTXOs) must cost one SQL confirm, never a wrong verdict — hence the
+escalation, exactly the SURVEY §2.2 design.
 """
 
 from __future__ import annotations
 
 import hashlib
+from collections import Counter
 from typing import Iterable, List, Sequence, Tuple
 
 import jax
@@ -37,7 +40,7 @@ def fingerprint(outpoint: Outpoint) -> int:
     tx_hash, index = outpoint
     digest = hashlib.sha256(
         bytes.fromhex(tx_hash) + index.to_bytes(2, "little")).digest()
-    return int.from_bytes(digest[:8], "little", signed=True)  # int64
+    return int.from_bytes(digest[:4], "little", signed=True)  # int32
 
 
 @jax.jit
@@ -51,32 +54,40 @@ class DeviceUtxoIndex:
     """Sorted-fingerprint membership prefilter, one per UTXO-class table."""
 
     def __init__(self, outpoints: Iterable[Outpoint] = ()):
-        self._fps = {fingerprint(o) for o in outpoints}
+        # MULTISET of fingerprints: two live outpoints may share one
+        # (expected ~n²/2³³ pairs — ~100 at 1M UTXOs).  A plain set would
+        # over-remove when one twin is spent, and a wrong "definitely
+        # absent" on the survivor would REJECT a valid block — the one
+        # error class a prefilter must never produce.
+        self._fps = Counter(fingerprint(o) for o in outpoints)
         self._dirty = True
         self._keys = None
 
     def __len__(self):
-        return len(self._fps)
+        return sum(self._fps.values())
 
     def add(self, outpoints: Iterable[Outpoint]) -> None:
         self._fps.update(fingerprint(o) for o in outpoints)
         self._dirty = True
 
     def remove(self, outpoints: Iterable[Outpoint]) -> None:
-        # NB: a (vanishingly rare) colliding pair would be over-removed;
-        # the escalation to storage keeps that sound — it only costs a
-        # false "maybe-not" turned into a definite miss for the twin.
-        self._fps.difference_update(fingerprint(o) for o in outpoints)
+        for o in outpoints:
+            fp = fingerprint(o)
+            left = self._fps[fp] - 1
+            if left > 0:
+                self._fps[fp] = left
+            else:
+                del self._fps[fp]
         self._dirty = True
 
     def _device_keys(self):
         if self._dirty:
-            keys = np.fromiter(iter(self._fps), dtype=np.int64,
+            keys = np.fromiter(self._fps.keys(), dtype=np.int32,
                                count=len(self._fps))
             keys.sort()
             # pad to a non-empty power-of-two length to bound recompiles
             n = max(1, 1 << (len(keys) - 1).bit_length()) if len(keys) else 1
-            pad = np.full(n - len(keys), np.iinfo(np.int64).max, dtype=np.int64)
+            pad = np.full(n - len(keys), np.iinfo(np.int32).max, dtype=np.int32)
             self._keys = jnp.asarray(np.concatenate([keys, pad]))
             self._dirty = False
         return self._keys
@@ -86,12 +97,12 @@ class DeviceUtxoIndex:
         if not outpoints:
             return np.zeros(0, dtype=bool)
         queries = np.fromiter(
-            (fingerprint(o) for o in outpoints), dtype=np.int64,
+            (fingerprint(o) for o in outpoints), dtype=np.int32,
             count=len(outpoints),
         )
         n = 1 << (len(queries) - 1).bit_length() if len(queries) else 1
         padded = np.concatenate([
-            queries, np.full(n - len(queries), np.iinfo(np.int64).min, np.int64)])
+            queries, np.full(n - len(queries), np.iinfo(np.int32).min, np.int32)])
         return np.asarray(
             _member_mask(self._device_keys(), jnp.asarray(padded))
         )[: len(outpoints)]

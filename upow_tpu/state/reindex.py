@@ -1,0 +1,79 @@
+"""UTXO reindex tool (reference create_unspent_outputs.py:37-41).
+
+    python -m upow_tpu.state.reindex [--db PATH] [--check]
+
+Rebuilds every UTXO-class table by replaying the transaction log in
+block order.  ``--check`` replays into the fingerprint only and compares
+it against the live tables without writing — the consensus-bug detector
+the reference runs in production (SURVEY.md §4 oracles).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from ..config import Config
+from .storage import ChainState
+
+
+async def amain(argv=None) -> int:
+    ap = argparse.ArgumentParser("upow_tpu reindex")
+    ap.add_argument("--db", default=None, help="chain sqlite path")
+    ap.add_argument("--check", action="store_true",
+                    help="verify only: replay and compare fingerprints")
+    args = ap.parse_args(argv)
+
+    cfg = Config.load()
+    db_path = args.db if args.db is not None else cfg.node.db_path
+    if not db_path:
+        print("no database configured (--db or UPOW_NODE_DB_PATH)")
+        return 2
+
+    work_path = db_path
+    tmpdir = None
+    if args.check:
+        # replay into a COPY: a mismatch must leave the live tables
+        # untouched as evidence, not overwrite them with the replay
+        import shutil
+        import sqlite3
+        import tempfile
+
+        tmpdir = tempfile.mkdtemp(prefix="upow_reindex_")
+        work_path = f"{tmpdir}/check.sqlite"
+        src = sqlite3.connect(db_path)
+        dst = sqlite3.connect(work_path)
+        src.backup(dst)
+        src.close()
+        dst.close()
+
+    state = ChainState(work_path)
+    try:
+        before = await state.get_unspent_outputs_hash()
+        blocks = await state.get_next_block_id() - 1
+        print(f"{blocks} blocks; live fingerprint {before}")
+        await state.rebuild_utxos()
+        after = await state.get_unspent_outputs_hash()
+        print(f"replayed fingerprint {after}")
+        if args.check and after != before:
+            print("MISMATCH: live UTXO set diverges from the tx log "
+                  "(consensus bug or corruption)")
+            return 1
+        if args.check:
+            print("OK: live tables match the replay")
+        return 0
+    finally:
+        state.close()
+        if tmpdir is not None:
+            import shutil
+
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def main() -> int:
+    return asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -609,9 +609,11 @@ class ChainState:
         manager.py:531-615.)  With the device index enabled, one
         ``searchsorted`` dispatch rejects definite misses first — a
         double-spend flood or bad fork costs one device call — and only
-        fingerprint "maybes" escalate to the batched SQL below (a hit is
-        not proof: a ground 64-bit collision must not flip a consensus
-        verdict)."""
+        fingerprint "maybes" escalate to the batched SQL below.  The
+        escalation is load-bearing, not a rarity: fingerprints are 32
+        bits (see device_index.py), so collisions are ~0.02%/query by
+        chance and trivially grindable on purpose — a hit must NEVER be
+        trusted as proof of existence."""
         if not outpoints:
             return []
         if self._dev_index is not None and table in self._dev_index:

@@ -6,7 +6,8 @@ around block creation (manager.py:655, 732-736) and UTXO deletes
 
 * :func:`span` — context manager that logs the wall time of a named
   section and feeds a process-wide stats registry (count / total /
-  max), exposed via :func:`stats` for the node's health surface.
+  max), exposed via :func:`stats` on the node's ``GET /`` health probe
+  (additive ``timings`` key).
 * :func:`profile` — wraps ``jax.profiler.trace`` so a kernel section
   can be captured for xprof/tensorboard when a trace dir is configured;
   a no-op otherwise (profiling must never take the node down).
