@@ -352,3 +352,38 @@ def test_reindex_tool(tmp_path, keys):
     db.commit()
     db.close()
     assert run(amain(["--db", str(tmp_path / "chain.sqlite"), "--check"])) == 1
+
+
+def test_reindex_detects_governance_corruption(tmp_path, keys):
+    """--check compares the FULL state fingerprint: corruption confined
+    to a governance table (invisible to the wire unspent_outputs hash)
+    must still fail the check."""
+
+    async def build():
+        state = ChainState(str(tmp_path / "gov.sqlite"))
+        manager = BlockManager(state, sig_backend="host")
+        await mine_and_accept(manager, state, keys["a1"], ts_offset=-5)
+        # stake 3 coins -> rows in unspent_outputs AND delegates_voting_power
+        spendable = await state.get_spendable_outputs(keys["a1"])
+        total = sum(i.amount for i in spendable)
+        outputs = [
+            TxOutput(keys["a1"], 3 * SMALLEST, OutputType.STAKE),
+            TxOutput(keys["a1"], 10 * SMALLEST, OutputType.DELEGATE_VOTING_POWER),
+            TxOutput(keys["a1"], total - 3 * SMALLEST),
+        ]
+        tx = Tx(spendable, outputs)
+        tx.sign([keys["d1"]], lambda i: keys["pub1"])
+        await mine_and_accept(manager, state, keys["a1"], txs=[tx], ts_offset=-1)
+        state.close()
+
+    run(build())
+    from upow_tpu.state.reindex import amain
+
+    assert run(amain(["--db", str(tmp_path / "gov.sqlite"), "--check"])) == 0
+    import sqlite3
+
+    db = sqlite3.connect(str(tmp_path / "gov.sqlite"))
+    db.execute("DELETE FROM delegates_voting_power")
+    db.commit()
+    db.close()
+    assert run(amain(["--db", str(tmp_path / "gov.sqlite"), "--check"])) == 1

@@ -51,3 +51,9 @@ def test_fingerprint_is_stable_and_signed32():
     assert fp == fingerprint(_op(7, 3))
     assert -(1 << 31) <= fp < (1 << 31)
     assert fingerprint(_op(7, 4)) != fp
+
+
+def test_remove_absent_outpoint_is_noop():
+    idx = DeviceUtxoIndex([_op(1)])
+    idx.remove([_op(99)])  # matches the SQL DELETE / old set semantics
+    assert list(idx.maybe_contains_batch([_op(1), _op(99)])) == [True, False]

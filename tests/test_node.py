@@ -501,3 +501,18 @@ def test_miner_cli_against_node(tmp_path, keys):
         assert bal == int(Decimal("1.5") * 10**8)
 
     run_cluster(tmp_path, scenario)
+
+
+def test_ipfilter_endpoint_slash_normalization(tmp_path):
+    """block_endpoints entries match with or without a leading slash
+    (docs/DEPLOY.md example must actually block)."""
+    cfg_path = tmp_path / "ip_config.json"
+    cfg_path.write_text(json.dumps({
+        "whitelist": [], "blocklist": [],
+        "block_endpoints": ["/send_to_address", "get_nodes"]}))
+    from upow_tpu.node.ipfilter import IpFilter
+
+    f = IpFilter(str(cfg_path))
+    assert not f.allowed("9.9.9.9", endpoint="/send_to_address")
+    assert not f.allowed("9.9.9.9", endpoint="/get_nodes")
+    assert f.allowed("9.9.9.9", endpoint="/get_block")

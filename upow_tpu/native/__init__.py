@@ -30,13 +30,15 @@ def _compile() -> bool:
     if gxx is None:
         return False
     os.makedirs(os.path.dirname(_LIB), exist_ok=True)
-    cmd = [gxx, "-O3", "-fPIC", "-shared", "-std=c++17", _SRC, "-o", _LIB + ".tmp"]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    except (subprocess.CalledProcessError, subprocess.TimeoutExpired):
-        return False
-    os.replace(_LIB + ".tmp", _LIB)
-    return True
+    base = [gxx, "-O3", "-fPIC", "-shared", "-std=c++17", _SRC, "-o", _LIB + ".tmp"]
+    for cmd in (base + ["-fopenmp"], base):  # OpenMP if the toolchain has it
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(_LIB + ".tmp", _LIB)
+            return True
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired):
+            continue
+    return False
 
 
 def load() -> Optional[ctypes.CDLL]:

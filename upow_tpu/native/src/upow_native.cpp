@@ -393,6 +393,11 @@ extern "C" void upow_p256_verify_batch(const uint8_t* z, const uint8_t* r,
                                        const uint8_t* s, const uint8_t* qx,
                                        const uint8_t* qy, size_t n,
                                        uint8_t* out) {
+  // embarrassingly parallel — one core per signature when OpenMP is
+  // available (the build adds -fopenmp when g++ supports it)
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
   for (size_t i = 0; i < n; i++)
     out[i] = uint8_t(upow_p256_verify(z + 32 * i, r + 32 * i, s + 32 * i,
                                       qx + 32 * i, qy + 32 * i));

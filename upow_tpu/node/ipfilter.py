@@ -65,7 +65,11 @@ class IpFilter:
                 data = json.load(f)
             self.whitelist = set(data.get("whitelist", []))
             self.blocklist = set(data.get("blocklist", []))
-            self.block_endpoints = set(data.get("block_endpoints", []))
+            # normalize: config entries may be written with or without a
+            # leading slash; matching strips both sides
+            self.block_endpoints = {
+                str(e).strip("/") for e in data.get("block_endpoints", [])
+            }
         except (json.JSONDecodeError, OSError):
             pass
 

@@ -76,8 +76,12 @@ class DeviceUtxoIndex:
             left = self._fps[fp] - 1
             if left > 0:
                 self._fps[fp] = left
-            else:
+            elif fp in self._fps:
                 del self._fps[fp]
+            # absent entries are a no-op, matching the SQL DELETE and the
+            # old set semantics (e.g. replaying a log whose spend
+            # references a never-created output must report a MISMATCH,
+            # not crash)
         self._dirty = True
 
     def _device_keys(self):

@@ -3,16 +3,21 @@
     python -m upow_tpu.state.reindex [--db PATH] [--check]
 
 Rebuilds every UTXO-class table by replaying the transaction log in
-block order.  ``--check`` replays into the fingerprint only and compares
-it against the live tables without writing — the consensus-bug detector
-the reference runs in production (SURVEY.md §4 oracles).
+block order.  ``--check`` replays into a backup copy and compares the
+full state fingerprint (all six UTXO-class tables, not just the
+wire-visible unspent_outputs hash) without touching the live database —
+the consensus-bug detector the reference runs in production
+(SURVEY.md §4 oracles).
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import shutil
+import sqlite3
 import sys
+import tempfile
 
 from ..config import Config
 from .storage import ChainState
@@ -22,7 +27,7 @@ async def amain(argv=None) -> int:
     ap = argparse.ArgumentParser("upow_tpu reindex")
     ap.add_argument("--db", default=None, help="chain sqlite path")
     ap.add_argument("--check", action="store_true",
-                    help="verify only: replay and compare fingerprints")
+                    help="verify only: replay a copy, compare fingerprints")
     args = ap.parse_args(argv)
 
     cfg = Config.load()
@@ -36,10 +41,6 @@ async def amain(argv=None) -> int:
     if args.check:
         # replay into a COPY: a mismatch must leave the live tables
         # untouched as evidence, not overwrite them with the replay
-        import shutil
-        import sqlite3
-        import tempfile
-
         tmpdir = tempfile.mkdtemp(prefix="upow_reindex_")
         work_path = f"{tmpdir}/check.sqlite"
         src = sqlite3.connect(db_path)
@@ -50,15 +51,15 @@ async def amain(argv=None) -> int:
 
     state = ChainState(work_path)
     try:
-        before = await state.get_unspent_outputs_hash()
+        before = await state.get_full_state_hash()
         blocks = await state.get_next_block_id() - 1
-        print(f"{blocks} blocks; live fingerprint {before}")
+        print(f"{blocks} blocks; live state fingerprint {before}")
         await state.rebuild_utxos()
-        after = await state.get_unspent_outputs_hash()
-        print(f"replayed fingerprint {after}")
+        after = await state.get_full_state_hash()
+        print(f"replayed state fingerprint {after}")
         if args.check and after != before:
-            print("MISMATCH: live UTXO set diverges from the tx log "
-                  "(consensus bug or corruption)")
+            print("MISMATCH: live UTXO-class tables diverge from the tx "
+                  "log (consensus bug or corruption)")
             return 1
         if args.check:
             print("OK: live tables match the replay")
@@ -66,8 +67,6 @@ async def amain(argv=None) -> int:
     finally:
         state.close()
         if tmpdir is not None:
-            import shutil
-
             shutil.rmtree(tmpdir, ignore_errors=True)
 
 

@@ -645,14 +645,30 @@ class ChainState:
         """UTXO-set fingerprint: sha256 over the sorted outpoint list —
         the cross-node state-equality oracle (reference database.py:827-830,
         logged every 10 blocks, exposed at GET /)."""
+        return await self.get_table_outpoints_hash("unspent_outputs")
+
+    async def get_table_outpoints_hash(self, table: str) -> str:
         import hashlib
 
         rows = self.db.execute(
-            "SELECT tx_hash, idx FROM unspent_outputs ORDER BY tx_hash, idx"
+            f"SELECT tx_hash, idx FROM {table} ORDER BY tx_hash, idx"
         ).fetchall()
         h = hashlib.sha256()
         for r in rows:
             h.update(f"{r['tx_hash']}{r['idx']}".encode())
+        return h.hexdigest()
+
+    async def get_full_state_hash(self) -> str:
+        """Fingerprint over ALL UTXO-class tables (governance included) —
+        what replay checks must compare: a divergence confined to e.g.
+        the validator ballot leaves the wire-visible unspent_outputs
+        fingerprint untouched."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for table in ("unspent_outputs",) + _GOV_TABLES:
+            h.update(table.encode())
+            h.update((await self.get_table_outpoints_hash(table)).encode())
         return h.hexdigest()
 
     # ------------------------------------------------------ address views --

@@ -75,26 +75,44 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto") -> List[bool]
 
     Pass 1 verifies against the raw-bytes digest; only failures re-try the
     hex-string digest (the reference's or-fallback).  ``backend='host'``
-    uses the C++/pure-Python path for tiny batches.
+    uses the C++/pure-Python path.
+
+    ``auto`` policy: the device batch only pays off on a real
+    accelerator — on a CPU-only host the XLA ladder costs minutes of
+    compile for throughput the OpenMP C++ batch beats anyway, so auto
+    means device iff jax's default backend is one, and the host batch
+    otherwise (small batches always stay host-side: dispatch overhead
+    dominates under ~8 signatures).
     """
     if not checks:
         return []
-    use_host = backend == "host" or (backend == "auto" and len(checks) < 8)
-    if use_host:
-        from .. import native
-        from ..core import curve
+    if backend == "auto":
+        if len(checks) < 8:
+            backend = "host"
+        else:
+            import jax
 
-        out = []
-        for digest, digest_hex, sig, pub in checks:
-            got = native.p256_verify(digest, sig[0], sig[1], pub[0], pub[1])
-            if got is None:
-                got = _host_verify_digest(digest, sig, pub)
-            if not got:
-                got2 = native.p256_verify(digest_hex, sig[0], sig[1], pub[0], pub[1])
-                if got2 is None:
-                    got2 = _host_verify_digest(digest_hex, sig, pub)
-                got = got2
-            out.append(bool(got))
+            backend = "host" if jax.default_backend() == "cpu" else "device"
+    if backend == "host":
+        from .. import native
+
+        batch = native.p256_verify_batch(
+            [c[0] for c in checks], [c[2] for c in checks],
+            [c[3] for c in checks])
+        if batch is None:
+            batch = [_host_verify_digest(c[0], c[2], c[3]) for c in checks]
+        out = list(map(bool, batch))
+        retry = [i for i, ok in enumerate(out) if not ok]
+        if retry:
+            second = native.p256_verify_batch(
+                [checks[i][1] for i in retry],
+                [checks[i][2] for i in retry],
+                [checks[i][3] for i in retry])
+            if second is None:
+                second = [_host_verify_digest(checks[i][1], checks[i][2],
+                                              checks[i][3]) for i in retry]
+            for i, ok in zip(retry, second):
+                out[i] = bool(ok)
         return out
 
     from ..crypto import p256
