@@ -387,3 +387,52 @@ def test_reindex_detects_governance_corruption(tmp_path, keys):
     db.commit()
     db.close()
     assert run(amain(["--db", str(tmp_path / "gov.sqlite"), "--check"])) == 1
+
+
+def test_big_block_batched_accept(keys):
+    """A few-hundred-tx block accepts through the BATCHED paths: one
+    aggregated signature batch (auto -> native/OpenMP on CPU hosts) and
+    chunked IN-query outpoint checks — the 8k-tx design point at test
+    scale (VERDICT #10; reference anti-pattern database.py:1390-1418)."""
+
+    async def scenario():
+        state = ChainState(device_index=True)
+        manager = BlockManager(state)  # auto sig backend
+        for i in range(3):
+            await mine_and_accept(manager, state, keys["a1"],
+                                  ts_offset=-6 + i)
+        # split a coinbase into many outputs, then spend each in one block
+        spendable = await state.get_spendable_outputs(keys["a1"])
+        n = 120
+        per = sum(i.amount for i in spendable) // n
+        fan = Tx(spendable, [TxOutput(keys["a1"], per) for _ in range(n)])
+        fan.sign([keys["d1"]], lambda i: keys["pub1"])
+        await mine_and_accept(manager, state, keys["a1"], txs=[fan],
+                              ts_offset=-2)
+
+        txs = []
+        for idx in range(n):
+            tx = Tx([TxInput(fan.hash(), idx)],
+                    [TxOutput(keys["a2"], per)])
+            tx.inputs[0].amount = per
+            tx.sign([keys["d1"]], lambda i: keys["pub1"])
+            txs.append(tx)
+        import time as _t
+
+        t0 = _t.perf_counter()
+        await mine_and_accept(manager, state, keys["a1"], txs=txs,
+                              ts_offset=-1)
+        accept_s = _t.perf_counter() - t0
+        assert await state.get_address_balance(keys["a2"]) == per * n
+        # batched accept must not degenerate to per-row Python loops:
+        # 120 signatures through the native batch + chunked SQL finish
+        # in a couple of seconds even on one core
+        assert accept_s < 30, accept_s
+
+        # replay oracle across the fan-out/fan-in structure
+        live = await state.get_full_state_hash()
+        await state.rebuild_utxos()
+        assert await state.get_full_state_hash() == live
+        state.close()
+
+    run(scenario())

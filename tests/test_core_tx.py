@@ -157,3 +157,32 @@ def test_fees_match_reference_semantics():
         ],
     )
     assert ours2.fees(input_amount=100) == 0
+
+
+def test_run_sig_checks_auto_uses_host_on_cpu(monkeypatch):
+    """auto dispatch: on a CPU-only backend even large batches stay on
+    the host C++/python path (the XLA ladder compile only pays off on a
+    real accelerator — txverify.run_sig_checks policy)."""
+    from upow_tpu.core import curve
+    from upow_tpu.verify import txverify
+
+    checks = []
+    for i in range(16):
+        d, pub = curve.keygen(rng=6000 + i)
+        msg = bytes([i]) * 12
+        sig = curve.sign(msg, d)
+        import hashlib
+
+        digest = hashlib.sha256(msg).digest()
+        digest_hex = hashlib.sha256(msg.hex().encode()).digest()
+        checks.append((digest, digest_hex, sig, pub))
+
+    called = {}
+
+    def boom(*a, **kw):
+        called["device"] = True
+        raise AssertionError("device path must not run on CPU auto")
+
+    monkeypatch.setattr("upow_tpu.crypto.p256.verify_batch_prehashed", boom)
+    out = txverify.run_sig_checks(checks, backend="auto")
+    assert out == [True] * 16 and "device" not in called
