@@ -264,3 +264,91 @@ def test_inode_deregistration_and_validator_revoke():
         state.close()
 
     run(main())
+
+
+def test_active_inodes_batch_matches_cascade():
+    """get_active_inodes' batched computation must equal the reference's
+    per-inode cascade (get_inode_vote_ratio_by_address per inode), built
+    on a chain with two inodes, two validators, and multiple delegates."""
+
+    async def main():
+        state = ChainState()
+        manager = BlockManager(state, sig_backend="host")
+        builder = WalletBuilder(state)
+        names = ["g", "i1", "i2", "v1", "v2", "d1", "d2", "d3"]
+        keys = {}
+        for j, nm in enumerate(names):
+            d, pub = curve.keygen(rng=7700 + j)
+            keys[nm] = (d, point_to_string(pub))
+        d_g, a_g = keys["g"]
+        for _ in range(420):
+            await mine_block(manager, state, a_g)
+        await push(state, await builder.create_transaction_to_send_multiple_wallet(
+            d_g, [keys["i1"][1], keys["d1"][1], keys["d2"][1], keys["d3"][1]],
+            ["1011", "41", "31", "21"]))
+        await push(state, await builder.create_transaction(
+            d_g, keys["i2"][1], "1011"))
+        await mine_block(manager, state, a_g, include_pending=True)
+        await push(state, await builder.create_transaction_to_send_multiple_wallet(
+            d_g, [keys["v1"][1], keys["v2"][1]], ["131", "121"]))
+        await mine_block(manager, state, a_g, include_pending=True)
+
+        for nm, amt in (("i1", "10"), ("i2", "10"), ("v1", "20"), ("v2", "10"),
+                        ("d1", "30"), ("d2", "20"), ("d3", "10")):
+            await push(state, await builder.create_stake_transaction(keys[nm][0], amt))
+        await mine_block(manager, state, a_g, include_pending=True)
+        for nm in ("v1", "v2"):
+            await push(state, await builder.create_validator_registration_transaction(
+                keys[nm][0]))
+        await mine_block(manager, state, a_g, include_pending=True)
+        for nm in ("i1", "i2"):
+            await push(state, await builder.create_inode_registration_transaction(
+                keys[nm][0]))
+        await mine_block(manager, state, a_g, include_pending=True)
+        # delegates vote for validators (split), validators vote for inodes
+        await push(state, await builder.create_voting_transaction(
+            keys["d1"][0], 6, keys["v1"][1]))
+        await push(state, await builder.create_voting_transaction(
+            keys["d2"][0], 10, keys["v2"][1]))
+        await push(state, await builder.create_voting_transaction(
+            keys["d3"][0], 5, keys["v1"][1]))
+        await mine_block(manager, state, a_g, include_pending=True)
+        await push(state, await builder.create_voting_transaction(
+            keys["v1"][0], 7, keys["i1"][1]))
+        await push(state, await builder.create_voting_transaction(
+            keys["v2"][0], 10, keys["i2"][1]))
+        await mine_block(manager, state, a_g, include_pending=True)
+
+        async def compare(check_pending_txs: bool):
+            active = await state.get_active_inodes(
+                check_pending_txs=check_pending_txs)
+            registered = await state.get_registered(
+                "inode_registration_output",
+                check_pending_txs=check_pending_txs)
+            for address, _ in registered:
+                oracle = await state.get_inode_vote_ratio_by_address(
+                    address, check_pending_txs=check_pending_txs)
+                got = [d["power"] for d in active if d["wallet"] == address]
+                if got:
+                    assert got == [oracle], (address, got, oracle)
+            return active
+
+        active = await compare(False)
+        assert len(active) == 2
+        assert sum(d["emission"] for d in active) <= 100
+
+        # pending mempool phase: an unmined revoke spends a ballot row and
+        # an unmined stake adds delegate weight — the batched path must
+        # track the cascade through the pending overlay too
+        clock.advance(48 * 3600 + 60)
+        await push(state, await builder.create_revoke_transaction(
+            keys["d2"][0], keys["v2"][1]))
+        d_o, a_o = curve.keygen(rng=7799)[0], point_to_string(
+            curve.keygen(rng=7799)[1])
+        await push(state, await builder.create_transaction(d_g, a_o, "15"))
+        pend_active = await compare(True)
+        assert {d["wallet"] for d in pend_active} <= {
+            keys["i1"][1], keys["i2"][1]}
+        state.close()
+
+    run(main())

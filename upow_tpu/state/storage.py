@@ -748,11 +748,14 @@ class ChainState:
     # --------------------------------------------------------- governance --
 
     async def get_registered(self, table: str,
-                             check_pending_txs: bool = False) -> List[Tuple[str, int]]:
+                             check_pending_txs: bool = False,
+                             pending: Optional[set] = None) -> List[Tuple[str, int]]:
         """(address, registered_at block timestamp) per registration output."""
         rows = self.db.execute(
             f"SELECT g.tx_hash, g.idx, g.address FROM {table} g").fetchall()
-        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
+        if pending is None:
+            pending = (await self.get_pending_spent_outpoints()) \
+                if check_pending_txs else set()
         out = []
         for r in rows:
             if (r["tx_hash"], r["idx"]) in pending:
@@ -807,31 +810,14 @@ class ChainState:
     async def get_votes_by_voter(self, table: str, voter: str,
                                  check_pending_txs: bool = False) -> List[dict]:
         """Standing votes cast BY ``voter`` (reference database.py:1557-1581
-        get_delegates_spent_votes shape: match on inputs_addresses[idx]).
-
-        One JOIN instead of a per-ballot-row transaction fetch (the
-        reference's N+1 shape, flagged in SURVEY §3 hot loops); the voter
-        match stays in Python because inputs_addresses is a JSON array."""
-        rows = self.db.execute(
-            f"SELECT g.tx_hash, g.idx, g.address, g.amount,"
-            f" t.inputs_addresses FROM {table} g"
-            f" JOIN transactions t ON t.tx_hash = g.tx_hash"
-        ).fetchall()
-        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
-        out = []
-        for r in rows:
-            if (r["tx_hash"], r["idx"]) in pending:
-                continue
-            inputs_addresses = json.loads(r["inputs_addresses"])
-            if r["idx"] >= len(inputs_addresses):
-                continue
-            if inputs_addresses[r["idx"]] != voter:
-                continue
-            out.append({
-                "tx_hash": r["tx_hash"], "index": r["idx"],
-                "recipient": r["address"], "vote": Decimal(r["amount"]) / SMALLEST,
-            })
-        return out
+        get_delegates_spent_votes shape) — a filter over
+        :meth:`_all_ballot_rows`, the single home of the voter rule."""
+        rows = await self._all_ballot_rows(table, check_pending_txs)
+        return [
+            {"tx_hash": r["tx_hash"], "index": r["index"],
+             "recipient": r["recipient"], "vote": r["vote"]}
+            for r in rows if r["voter"] == voter
+        ]
 
     async def get_validators_stake(self, validator: str,
                                    check_pending_txs: bool = False) -> Decimal:
@@ -861,17 +847,77 @@ class ChainState:
             total += entry["vote"] * stake / 10
         return round_up_decimal(total)
 
+    async def _all_ballot_rows(self, table: str,
+                               check_pending_txs: bool = False,
+                               pending: Optional[set] = None) -> List[dict]:
+        """Every standing ballot row with its voter resolved — ONE join
+        instead of a query per recipient per row.  The voter rule (vote
+        output's ``inputs_addresses[output_index]``) lives HERE only;
+        get_votes_by_voter and get_active_inodes are filters over it."""
+        rows = self.db.execute(
+            f"SELECT g.tx_hash, g.idx, g.address AS recipient, g.amount,"
+            f" t.inputs_addresses FROM {table} g"
+            f" JOIN transactions t ON t.tx_hash = g.tx_hash"
+        ).fetchall()
+        if pending is None:
+            pending = (await self.get_pending_spent_outpoints()) \
+                if check_pending_txs else set()
+        out = []
+        for r in rows:
+            if (r["tx_hash"], r["idx"]) in pending:
+                continue
+            addrs = json.loads(r["inputs_addresses"])
+            voter = addrs[r["idx"]] if r["idx"] < len(addrs) else None
+            out.append({
+                "tx_hash": r["tx_hash"], "index": r["idx"],
+                "recipient": r["recipient"], "voter": voter,
+                "vote": Decimal(r["amount"]) / SMALLEST,
+            })
+        return out
+
     async def get_active_inodes(self, check_pending_txs: bool = False) -> List[dict]:
         """Registered inodes with power/emission; active = emission >= 1% or
-        registered within 48 h (reference database.py:1377-1388)."""
+        registered within 48 h (reference database.py:1377-1388).
+
+        The reference computes this through an O(inodes x votes x
+        ballots) SQL cascade per block accept (database.py:1390-1426,
+        SURVEY §3 hot loop #3).  Here it is three bulk reads + one
+        batched stake query; the per-level round_up_decimal calls mirror
+        the cascade's rounding exactly (per-validator stake rounded,
+        then per-inode power rounded)."""
+        pending = (await self.get_pending_spent_outpoints()) \
+            if check_pending_txs else set()
         registered = await self.get_registered(
-            "inode_registration_output", check_pending_txs)
+            "inode_registration_output", check_pending_txs, pending=pending)
+        vrows = await self._all_ballot_rows(
+            "validators_ballot", check_pending_txs, pending=pending)
+        stakes = await self.get_multiple_address_stakes(
+            {r["voter"] for r in vrows if r["voter"]}, check_pending_txs,
+            pending=pending)
+        vstake_raw: Dict[str, Decimal] = {}
+        for r in vrows:
+            if r["voter"] is None:
+                continue
+            vstake_raw[r["recipient"]] = vstake_raw.get(
+                r["recipient"], Decimal(0)) \
+                + r["vote"] * stakes.get(r["voter"], Decimal(0)) / 10
+        validators_stake = {k: round_up_decimal(v)
+                            for k, v in vstake_raw.items()}
+        irows = await self._all_ballot_rows(
+            "inodes_ballot", check_pending_txs, pending=pending)
+        power_raw: Dict[str, Decimal] = {}
+        for r in irows:
+            if r["voter"] is None:
+                continue
+            power_raw[r["recipient"]] = power_raw.get(
+                r["recipient"], Decimal(0)) \
+                + r["vote"] * validators_stake.get(r["voter"], Decimal(0)) / 10
         details = []
         for address, registered_at in registered:
-            power = await self.get_inode_vote_ratio_by_address(
-                address, check_pending_txs)
             details.append({
-                "wallet": address, "power": power, "registered_at": registered_at,
+                "wallet": address,
+                "power": round_up_decimal(power_raw.get(address, Decimal(0))),
+                "registered_at": registered_at,
             })
         total_power = sum(d["power"] for d in details)
         active = []
@@ -974,7 +1020,8 @@ class ChainState:
 
     async def get_multiple_address_stakes(
             self, addresses: Iterable[str],
-            check_pending_txs: bool = False) -> Dict[str, Decimal]:
+            check_pending_txs: bool = False,
+            pending: Optional[set] = None) -> Dict[str, Decimal]:
         """Batch stake query (reference database.py:1208-1290): one pass over
         unspent stake outputs + one pass over the mempool for all addresses."""
         addresses = list(set(addresses))
@@ -986,7 +1033,9 @@ class ChainState:
             f"SELECT tx_hash, idx, address, amount FROM unspent_outputs"
             f" WHERE is_stake = 1 AND address IN ({placeholders})", addresses,
         ).fetchall()
-        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
+        if pending is None:
+            pending = (await self.get_pending_spent_outpoints()) \
+                if check_pending_txs else set()
         for r in rows:
             if (r["tx_hash"], r["idx"]) in pending:
                 continue
