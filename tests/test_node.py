@@ -516,3 +516,19 @@ def test_ipfilter_endpoint_slash_normalization(tmp_path):
     assert not f.allowed("9.9.9.9", endpoint="/send_to_address")
     assert not f.allowed("9.9.9.9", endpoint="/get_nodes")
     assert f.allowed("9.9.9.9", endpoint="/get_block")
+
+
+def test_rate_limits(tmp_path, keys):
+    """slowapi-parity limits: GET / allows 3/minute then 429s; unlisted
+    endpoints (push_block et al.) are never limited (main.py:267...)."""
+
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        for _ in range(3):
+            assert (await client.get("/")).status == 200
+        assert (await client.get("/")).status == 429
+        # unlimited endpoint still fine
+        for _ in range(6):
+            assert (await client.get("/get_nodes")).status == 200
+
+    run_cluster(tmp_path, scenario)

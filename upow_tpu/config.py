@@ -62,6 +62,7 @@ class NodeConfig:
     sync_reorg_window: int = 500    # main.py:167-185
     sync_page: int = 1000           # block download page (main.py:188-192)
     mempool_clean_interval: int = 600  # main.py:678-683
+    rate_limits_enabled: bool = True   # slowapi parity (main.py:55)
 
 
 @dataclass

@@ -68,6 +68,10 @@ class Node:
             self.state, sig_backend=self.config.device.sig_backend)
         self.peers = PeerBook(self.config.node)
         self.ip_filter = IpFilter(self.config.node.ip_config_file)
+        from .ratelimit import RateLimiter
+
+        self.rate_limiter = RateLimiter(
+            enabled=self.config.node.rate_limits_enabled)
         self.is_syncing = False
         self.started = False
         self.self_url = self.config.node.self_url
@@ -177,6 +181,9 @@ class Node:
             return web.json_response(
                 {"ok": False, "error": "Access forbidden temporarily."},
                 status=403)
+        if not self.rate_limiter.allow(client_ip, normalized):
+            return web.json_response(
+                {"ok": False, "error": "Rate limit exceeded"}, status=429)
 
         sender = request.headers.get("Sender-Node")
         if sender:
@@ -776,8 +783,12 @@ class Node:
                 try:
                     blocks = await iface.get_blocks(i, cfg.sync_page)
                 except Exception as e:
+                    # a failed page (peer down, response cap, or the
+                    # peer's 40/minute get_blocks rate limit on a long
+                    # catch-up) must NOT fall through to the success
+                    # return below — report it so callers retry
                     log.error("sync fetch failed: %s", e)
-                    break
+                    return f"sync fetch failed: {e}"
                 try:
                     _, last_block = await self.manager.calculate_difficulty()
                     if not blocks:
