@@ -382,9 +382,21 @@ def verify_batch_prehashed(
     pubkeys: Sequence[Tuple[int, int]],
     pad_block: int = 128,
     backend: Optional[str] = None,
+    mesh=None,
 ) -> np.ndarray:
+    """``mesh``: a jax.sharding.Mesh — the padded batch is placed with
+    its lane axis sharded over the mesh ("dp"), so the elementwise
+    verify program runs SPMD with zero collectives (SURVEY §2.3 DP
+    verify).  Without it, inputs live on one device.  Only the jnp
+    backend shards this way (the pallas kernel's grid is per-device)."""
     n = len(digests)
     assert len(signatures) == n and len(pubkeys) == n
+    if mesh is not None:
+        import math
+
+        n_dev = mesh.devices.size
+        # padded length must split evenly across the mesh
+        pad_block = pad_block * n_dev // math.gcd(pad_block, n_dev)
     if n == 0:
         return np.zeros(0, dtype=bool)
 
@@ -423,6 +435,10 @@ def verify_batch_prehashed(
 
     if backend is None:
         backend = "pallas" if jax.default_backend() == "tpu" else "jnp"
+    if mesh is not None and backend == "pallas":
+        raise ValueError(
+            "mesh sharding is only wired for the jnp backend; pass "
+            "backend='jnp' (the pallas kernel runs one device's shard)")
     if backend == "pallas":
         flags = jnp.asarray(np.stack([
             np.pad(np.array(rnoks, dtype=np.int32), (0, pad)),
@@ -432,9 +448,14 @@ def verify_batch_prehashed(
             digits(u1s), digits(u2s), arr(qxs), arr(qys), arr(rms),
             arr(rnms), flags, tile=min(256, padded))
     else:
-        out = _verify_device(
+        inputs = (
             digits(u1s), digits(u2s), arr(qxs), arr(qys), arr(rms), arr(rnms),
             jnp.asarray(np.pad(np.array(rnoks, dtype=bool), (0, pad))),
             jnp.asarray(np.pad(np.array(valids, dtype=bool), (0, pad))),
         )
+        if mesh is not None:
+            from ..parallel.mesh import shard_batch_arrays
+
+            inputs = shard_batch_arrays(mesh, *inputs)
+        out = _verify_device(*inputs)
     return np.asarray(out)[:n]

@@ -813,7 +813,7 @@ class Node:
                         await self.state.remove_blocks(last_common_block + 1)
                         await self.create_blocks(local_cache, [])
                     return errors[0] if errors else e
-            return True
+            # unreachable: the loop exits only via the returns above
         finally:
             await iface.close()
 
