@@ -532,3 +532,34 @@ def test_rate_limits(tmp_path, keys):
             assert (await client.get("/get_nodes")).status == 200
 
     run_cluster(tmp_path, scenario)
+
+
+def test_miner_cli_reference_positionals(tmp_path, keys):
+    """`python -m upow_tpu.mine.miner <addr> <workers> <node_url>` — the
+    reference's positional CLI shape (miner.py:126-156) REALLY spawns
+    worker subprocesses on disjoint shards; one of them mines the
+    genesis block (real wall clock: the children cannot see the test's
+    clock offset, and genesis needs no predecessor timestamp)."""
+
+    async def scenario(cluster):
+        from upow_tpu.core import clock
+        from upow_tpu.mine import miner as miner_cli
+
+        node, client = await cluster.add_node("a")
+        node_url = cluster.url(0) + "/"
+        clock.reset()  # children use the real clock; so must the node
+        loop = asyncio.get_running_loop()
+
+        def mine_once():
+            return miner_cli.main([keys["addr"], "2", node_url,
+                                   "--device", "python",
+                                   "--batch", str(1 << 14), "--once"])
+
+        assert await loop.run_in_executor(None, mine_once) == 0
+        assert await node.state.get_next_block_id() == 2
+        # tpu fan-out is refused rather than letting N processes fight
+        # over the single-client chip
+        assert miner_cli.main([keys["addr"], "2", node_url,
+                               "--device", "tpu", "--once"]) == 2
+
+    run_cluster(tmp_path, scenario)

@@ -124,9 +124,64 @@ def run(address: str, node: str, device: str, batch: int, ttl: float,
             return 0 if reply.get("ok") else 1
 
 
+def _reap(procs, timeout: float = 5.0) -> None:
+    import subprocess
+
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+
+
+def _run_workers(args) -> int:
+    """Reference-style multi-process fan-out (miner.py:126-156): worker i
+    takes contiguous shard i/N.  CPU-parity path — one process drives a
+    whole TPU, so fanning out there would just contend for the chip."""
+    import subprocess
+
+    if args.device in ("tpu", "pallas"):
+        print("workers>1 with --device tpu would have every process fight "
+              "over the one chip (libtpu is single-client); use --device "
+              "cpu, or shard across hosts with --shard/UPOW_COORDINATOR_"
+              "ADDRESS", file=sys.stderr)
+        return 2
+    procs = []
+    base = [sys.executable, "-m", "upow_tpu.mine.miner", args.address,
+            "--node", args.node, "--device", args.device,
+            "--batch", str(args.batch), "--ttl", str(args.ttl)]
+    if args.once:
+        base.append("--once")
+    for i in range(args.workers):
+        procs.append(subprocess.Popen(
+            base + ["--shard", f"{i}/{args.workers}"]))
+    try:
+        while True:
+            codes = [p.poll() for p in procs]
+            if any(c == 0 for c in codes):
+                _reap(procs)  # first finder wins; stop the losers
+                return 0
+            if all(c is not None for c in codes):
+                return max(codes)
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        _reap(procs)
+        return 130
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="uPow TPU miner")
     ap.add_argument("address")
+    ap.add_argument("workers", nargs="?", type=int, default=0,
+                    help="reference-compatible positional: spawn N host "
+                         "processes on disjoint nonce shards "
+                         "(miner.py:126-156); 0 = single process")
+    ap.add_argument("node_pos", nargs="?", default=None,
+                    help="reference-compatible positional node URL")
     ap.add_argument("--node", default="http://localhost:3006/")
     ap.add_argument("--device", default="tpu",
                     help="tpu|cpu or explicit backend pallas|jnp|native|python")
@@ -135,6 +190,10 @@ def main(argv=None) -> int:
     ap.add_argument("--shard", default="0/1", help="i/k disjoint nonce-range shard")
     ap.add_argument("--once", action="store_true", help="mine a single template and exit")
     args = ap.parse_args(argv)
+    if args.node_pos:
+        args.node = args.node_pos
+    if args.workers > 1:
+        return _run_workers(args)
     i, k = (int(x) for x in args.shard.split("/"))
     assert 0 <= i < k, "--shard must be i/k with 0 <= i < k"
     if (i, k) == (0, 1):
