@@ -1,0 +1,146 @@
+"""Adversarial-input robustness: the codec and node intake must reject
+malformed bytes with clean errors, never crash or accept garbage.
+
+(The reference's decoder runs on anything peers POST at it —
+transaction.py:520-592 behind /push_tx; a parser crash there is a
+remote DoS.)"""
+
+import asyncio
+import random
+
+import pytest
+
+from upow_tpu.core import curve, point_to_string
+from upow_tpu.core.codecs import OutputType
+from upow_tpu.core.tx import Tx, TxInput, TxOutput, tx_from_hex
+
+
+def _valid_tx_hex() -> str:
+    d, pub = curve.keygen(rng=0xF722)
+    tx = Tx([TxInput("ab" * 32, 0)],
+            [TxOutput(point_to_string(pub), 5_0000_0000)])
+    tx.sign([d], lambda i: pub)
+    return tx.hex()
+
+
+def test_random_bytes_never_crash():
+    rng = random.Random(1234)
+    for length in (0, 1, 2, 7, 33, 64, 105, 300):
+        for _ in range(40):
+            blob = bytes(rng.randrange(256) for _ in range(length)).hex()
+            try:
+                tx = tx_from_hex(blob, check_signatures=False)
+            except (ValueError, IndexError, KeyError, AssertionError,
+                    NotImplementedError):
+                continue  # clean rejection (NotImplementedError matches
+                # the reference's version>3 raise, transaction.py:525)
+            try:
+                rt = tx.hex()
+            except ValueError:
+                continue  # parsed but unserializable (e.g. unsigned)
+            assert rt != ""
+
+
+def test_mutated_valid_tx_never_crashes():
+    base = bytes.fromhex(_valid_tx_hex())
+    rng = random.Random(99)
+    for _ in range(300):
+        mutated = bytearray(base)
+        for _ in range(rng.randrange(1, 4)):
+            mutated[rng.randrange(len(mutated))] = rng.randrange(256)
+        try:
+            tx = tx_from_hex(bytes(mutated).hex(), check_signatures=False)
+        except (ValueError, IndexError, KeyError, AssertionError,
+                NotImplementedError):
+            continue
+        assert tx is not None
+
+
+def test_truncations_rejected():
+    base = _valid_tx_hex()
+    for cut in range(2, len(base), 14):
+        blob = base[:cut]
+        if len(blob) % 2:
+            blob += "0"
+        if blob == base:
+            continue
+        try:
+            tx = tx_from_hex(blob, check_signatures=False)
+        except (ValueError, IndexError, AssertionError,
+                NotImplementedError):
+            continue  # clean rejection
+        # parsers may tolerate truncation only by consuming less — the
+        # result must still be serializable or cleanly refuse
+        try:
+            tx.hex()
+        except ValueError:
+            pass
+
+
+def test_push_tx_endpoint_survives_garbage(tmp_path):
+    """Garbage at the HTTP boundary: every request answers ok:false —
+    no 500s, node keeps serving."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from upow_tpu.node.app import Node
+    from test_node import make_config
+
+    async def main():
+        node = Node(make_config(tmp_path, "fuzz"))
+        server = TestServer(node.app)
+        await server.start_server()
+        client = TestClient(server)
+        node.started = True
+        try:
+            cases = ["", "zz", "00", "ff" * 500, _valid_tx_hex()[:-10],
+                     "03" + "00" * 200]
+            for blob in cases:
+                resp = await client.get("/push_tx", params={"tx_hex": blob})
+                body = await resp.json()
+                assert body["ok"] is False, blob[:40]
+            # node still healthy afterwards
+            resp = await client.get("/get_mining_info")
+            assert (await resp.json())["ok"]
+        finally:
+            await node.close()
+            await client.close()
+            await server.close()
+
+    asyncio.run(main())
+
+
+def test_push_tx_rejects_coinbase_and_unsigned(tmp_path):
+    """A pushed coinbase would pass every input-based check vacuously and
+    poison the mempool (reference database.py:93-96 rejects it); a blob
+    with a zeroed signature section parses with signature=None inputs
+    and must reject cleanly, not 500 in serialization."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from upow_tpu.core.tx import CoinbaseTx
+    from upow_tpu.node.app import Node
+    from test_node import make_config
+
+    async def main():
+        node = Node(make_config(tmp_path, "fuzz2"))
+        server = TestServer(node.app)
+        await server.start_server()
+        client = TestClient(server)
+        node.started = True
+        try:
+            d, pub = curve.keygen(rng=0xF723)
+            coinbase_hex = CoinbaseTx("cd" * 32, point_to_string(pub),
+                                      6_0000_0000).hex()
+            valid = bytes.fromhex(_valid_tx_hex())
+            unsigned = valid[:-65] + b"\x00"  # zero the signature count
+            for blob in (coinbase_hex, unsigned.hex()):
+                resp = await client.get("/push_tx", params={"tx_hex": blob})
+                assert resp.status == 200, blob[:40]
+                body = await resp.json()
+                assert body["ok"] is False, blob[:40]
+            assert await node.state.get_pending_transactions_count() == 0
+        finally:
+            await node.close()
+            await client.close()
+            await server.close()
+
+    asyncio.run(main())

@@ -62,6 +62,8 @@ class TxInput:
         )
 
     def signature_hex(self) -> str:
+        if self.signature is None:
+            raise ValueError("cannot serialize an unsigned input")
         r, s = self.signature
         return r.to_bytes(32, ENDIAN).hex() + s.to_bytes(32, ENDIAN).hex()
 

@@ -36,6 +36,7 @@ from ..core.tx import CoinbaseTx, Tx, tx_from_hex
 from ..logger import get_logger, setup_logging
 from ..state.storage import ChainState
 from ..verify.block import BlockManager
+from ..verify.txverify import TxVerifier
 from .ipfilter import IpFilter, is_local_ip
 from .peers import NodeInterface, PeerBook, _normalize
 
@@ -241,6 +242,14 @@ class Node:
     # ------------------------------------------------------- tx intake ----
     async def _verify_and_push_tx(self, tx: Tx,
                                   sender: Optional[str]) -> dict:
+        # a coinbase is only ever built by block acceptance — a pushed one
+        # would pass every input-based check vacuously, poison the mempool
+        # (no inputs -> GC never clears it) and break every mined block
+        # (reference database.py:93-96 rejects it explicitly); unsigned
+        # inputs would crash serialization below instead of rejecting
+        if getattr(tx, "is_coinbase", False) or any(
+                i.signature is None for i in tx.inputs):
+            return {"ok": False, "error": "Transaction has not been added"}
         tx_hash = tx.hash()
         if tx_hash in self.tx_cache:
             return {"ok": False, "error": "Transaction just added"}
@@ -252,6 +261,19 @@ class Node:
             return {"ok": False, "error": "Access forbidden temporarily."}
         if await self.state.pending_transaction_exists(tx_hash):
             return {"ok": False, "error": "Transaction already present"}
+        # full verification BEFORE the mempool (the reference's
+        # add_pending_transaction(verify=True) → Transaction.verify_pending,
+        # database.py:93-111): rules + signatures + pending double spend.
+        # Without this, any parseable garbage enters the mempool and gets
+        # handed to miners, whose blocks then fail acceptance.
+        try:
+            ok = await TxVerifier(self.state).verify_pending(
+                tx, sig_backend=self.config.device.sig_backend)
+        except Exception as e:
+            log.info("tx verify error %s: %s", tx_hash, e)
+            ok = False
+        if not ok:
+            return {"ok": False, "error": "Transaction has not been added"}
         try:
             await self.state.add_pending_transaction(tx)
         except Exception as e:
