@@ -66,16 +66,44 @@ class MiningJob:
         return check_pow_hash(digest, self.previous_hash, self.difficulty)
 
 
-def _make_dispatcher(job: MiningJob, backend: str) -> Optional[Callable]:
+def _make_dispatcher(job: MiningJob, backend: str,
+                     mesh_devices: int = 0) -> Optional[Callable]:
     """For device backends: dispatch(start, count) -> async device handle.
 
     The handle resolves via ``int()``; keeping several dispatches in
     flight hides the host↔device round-trip (which otherwise caps the
-    hash rate — measured ~2x on a tunneled v5e chip)."""
-    if backend not in ("pallas", "jnp"):
+    hash rate — measured ~2x on a tunneled v5e chip).
+
+    ``backend='mesh'`` shards each round over the device mesh
+    (shard_map + pmin; config device.mesh_devices caps the mesh size,
+    0 = all visible devices)."""
+    if backend not in ("pallas", "jnp", "mesh"):
         return None
     template = sha_kernel.make_template(job.prefix)
     spec = sha_kernel.target_spec(job.previous_hash, job.difficulty)
+    if backend == "mesh":
+        import jax
+
+        from ..parallel.mesh import make_mesh, pow_search_sharded
+
+        devices = jax.devices()
+        if mesh_devices:
+            devices = devices[:mesh_devices]
+        mesh = make_mesh(devices)
+        n_dev = len(devices)
+
+        def dispatch(start: int, count: int):
+            # ceil: cover every nonce in [start, start+count) — a short
+            # final round may overlap the next range or (at the very top
+            # of the space) touch the excluded sentinel nonce
+            # 0xFFFFFFFF / wrap to low nonces in uint32: duplicate work
+            # or the already-documented MAX_SEARCH_END exclusion, never
+            # a missed in-range hit (the min-reduction prefers real hits
+            # over the sentinel)
+            per_dev = max(1, (count + n_dev - 1) // n_dev)
+            return pow_search_sharded(template, spec, start, per_dev, mesh)
+
+        return dispatch
     fn = sha_kernel.pow_search_pallas if backend == "pallas" else sha_kernel.pow_search_jnp
 
     def dispatch(start: int, count: int):
@@ -133,7 +161,8 @@ class MineResult:
 
 def mine(job: MiningJob, backend: str = "jnp", *, start: int = 0,
          stride_end: int = NONCE_SPACE, batch: int = 1 << 22,
-         ttl: float = 90.0, progress: Optional[Callable] = None) -> MineResult:
+         ttl: float = 90.0, progress: Optional[Callable] = None,
+         mesh_devices: int = 0) -> MineResult:
     """Search [start, stride_end) in fixed rounds until hit or TTL.
 
     ``start``/``stride_end`` let a coordinator hand disjoint nonce ranges to
@@ -145,7 +174,7 @@ def mine(job: MiningJob, backend: str = "jnp", *, start: int = 0,
     tried = 0
     cursor = start
 
-    dispatch = _make_dispatcher(job, backend)
+    dispatch = _make_dispatcher(job, backend, mesh_devices=mesh_devices)
     if dispatch is not None:
         # Pipelined device rounds: keep `depth` dispatches in flight so the
         # chip never idles while the host blocks on a result.  A hit wastes

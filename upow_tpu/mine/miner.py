@@ -69,7 +69,7 @@ def push_block(node: str, block_content: str, txs: list, block_no: int) -> dict:
 
 
 def select_backend(device: str) -> str:
-    if device in ("pallas", "jnp", "native", "python"):
+    if device in ("pallas", "jnp", "native", "python", "mesh"):
         return device
     if device == "tpu":
         return "pallas"
@@ -81,7 +81,8 @@ def select_backend(device: str) -> str:
 
 
 def run(address: str, node: str, device: str, batch: int, ttl: float,
-        shard: tuple = (0, 1), once: bool = False) -> int:
+        shard: tuple = (0, 1), once: bool = False,
+        mesh_devices: int = 0) -> int:
     backend = select_backend(device)
     i, k = shard
     from ..parallel.multihost import plan_nonce_ranges
@@ -104,7 +105,7 @@ def run(address: str, node: str, device: str, batch: int, ttl: float,
             print(f"{tried / elapsed / 1e6:.2f} MH/s ({tried} hashes)")
 
         result = mine(job, backend, start=lo, stride_end=hi, batch=batch,
-                      ttl=ttl, progress=progress)
+                      ttl=ttl, progress=progress, mesh_devices=mesh_devices)
         if result.nonce is None:
             print(f"template expired after {result.hashes_tried} hashes; refreshing")
             if once:
@@ -144,7 +145,7 @@ def _run_workers(args) -> int:
     whole TPU, so fanning out there would just contend for the chip."""
     import subprocess
 
-    if args.device in ("tpu", "pallas"):
+    if args.device in ("tpu", "pallas", "mesh"):
         print("workers>1 with --device tpu would have every process fight "
               "over the one chip (libtpu is single-client); use --device "
               "cpu, or shard across hosts with --shard/UPOW_COORDINATOR_"
@@ -184,12 +185,19 @@ def main(argv=None) -> int:
                     help="reference-compatible positional node URL")
     ap.add_argument("--node", default="http://localhost:3006/")
     ap.add_argument("--device", default="tpu",
-                    help="tpu|cpu or explicit backend pallas|jnp|native|python")
-    ap.add_argument("--batch", type=int, default=1 << 22)
+                    help="tpu|cpu or explicit backend "
+                         "pallas|jnp|mesh|native|python")
+    ap.add_argument("--batch", type=int, default=0,
+                    help="nonces per dispatch (0 = config device.search_batch)")
     ap.add_argument("--ttl", type=float, default=90.0)
     ap.add_argument("--shard", default="0/1", help="i/k disjoint nonce-range shard")
     ap.add_argument("--once", action="store_true", help="mine a single template and exit")
     args = ap.parse_args(argv)
+    from ..config import Config
+
+    cfg = Config.load()
+    if args.batch <= 0:
+        args.batch = cfg.device.search_batch
     if args.node_pos:
         args.node = args.node_pos
     if args.workers > 1:
@@ -208,7 +216,8 @@ def main(argv=None) -> int:
             print(f"distributed mining: process {i}/{k}")
     node = args.node.rstrip("/") + "/"
     return run(args.address, node, args.device, args.batch, args.ttl,
-               shard=(i, k), once=args.once)
+               shard=(i, k), once=args.once,
+               mesh_devices=cfg.device.mesh_devices)
 
 
 if __name__ == "__main__":

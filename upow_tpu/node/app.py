@@ -66,7 +66,8 @@ class Node:
         self.state = ChainState(self.config.node.db_path or None,
                                 device_index=self.config.device.utxo_index)
         self.manager = BlockManager(
-            self.state, sig_backend=self.config.device.sig_backend)
+            self.state, sig_backend=self.config.device.sig_backend,
+            verify_pad_block=self.config.device.verify_pad_block)
         self.peers = PeerBook(self.config.node)
         self.ip_filter = IpFilter(self.config.node.ip_config_file)
         from .ratelimit import RateLimiter
@@ -267,8 +268,10 @@ class Node:
         # Without this, any parseable garbage enters the mempool and gets
         # handed to miners, whose blocks then fail acceptance.
         try:
-            ok = await TxVerifier(self.state).verify_pending(
-                tx, sig_backend=self.config.device.sig_backend)
+            ok = await TxVerifier(
+                self.state,
+                verify_pad_block=self.config.device.verify_pad_block,
+            ).verify_pending(tx, sig_backend=self.config.device.sig_backend)
         except Exception as e:
             log.info("tx verify error %s: %s", tx_hash, e)
             ok = False

@@ -75,9 +75,11 @@ MERKLE_EXCEPTION = (
 class BlockManager:
     """Difficulty, check_block, create_block over one ChainState."""
 
-    def __init__(self, state: ChainState, sig_backend: str = "auto"):
+    def __init__(self, state: ChainState, sig_backend: str = "auto",
+                 verify_pad_block: int = 128):
         self.state = state
         self.sig_backend = sig_backend
+        self.verify_pad_block = verify_pad_block
         self._difficulty_cache: Optional[Tuple[Decimal, dict]] = None
         self._inode_cache: Optional[List[dict]] = None
         self._inode_cache_time = 0.0
@@ -181,7 +183,8 @@ class BlockManager:
                 errors.append(f"transaction {tx.hash()} has been not verified")
                 return False
             all_checks.extend(checks)
-        if not all(run_sig_checks(all_checks, backend=self.sig_backend)):
+        if not all(run_sig_checks(all_checks, backend=self.sig_backend,
+                                  pad_block=self.verify_pad_block)):
             errors.append("signature verification failed")
             return False
 

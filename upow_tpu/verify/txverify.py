@@ -70,7 +70,8 @@ def _dedup_sig_checks(tx: Tx, voter: bool,
     return checks
 
 
-def run_sig_checks(checks: Sequence[tuple], backend: str = "auto") -> List[bool]:
+def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
+                   pad_block: int = 128) -> List[bool]:
     """Verify deferred checks in one (or two) batched device calls.
 
     Pass 1 verifies against the raw-bytes digest; only failures re-try the
@@ -118,14 +119,16 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto") -> List[bool]
     from ..crypto import p256
 
     first = p256.verify_batch_prehashed(
-        [c[0] for c in checks], [c[2] for c in checks], [c[3] for c in checks])
+        [c[0] for c in checks], [c[2] for c in checks], [c[3] for c in checks],
+        pad_block=pad_block)
     out = list(map(bool, first))
     retry = [i for i, ok in enumerate(out) if not ok]
     if retry:
         second = p256.verify_batch_prehashed(
             [checks[i][1] for i in retry],
             [checks[i][2] for i in retry],
-            [checks[i][3] for i in retry])
+            [checks[i][3] for i in retry],
+            pad_block=pad_block)
         for i, ok in zip(retry, second):
             out[i] = bool(ok)
     return out
@@ -153,9 +156,11 @@ class TxVerifier:
     method cites its reference lines.
     """
 
-    def __init__(self, state: ChainState, is_syncing: bool = False):
+    def __init__(self, state: ChainState, is_syncing: bool = False,
+                 verify_pad_block: int = 128):
         self.state = state
         self.is_syncing = is_syncing
+        self.verify_pad_block = verify_pad_block
 
     # -- address resolution ------------------------------------------------
 
@@ -418,7 +423,8 @@ class TxVerifier:
         checks = await self.collect_sig_checks(tx)
         if checks is None:
             return False
-        return all(run_sig_checks(checks, backend=sig_backend))
+        return all(run_sig_checks(checks, backend=sig_backend,
+                                  pad_block=self.verify_pad_block))
 
     async def verify_pending(self, tx: Tx, sig_backend: str = "auto") -> bool:
         """add-pending intake check (transaction.py:481-482)."""
