@@ -352,3 +352,53 @@ def test_active_inodes_batch_matches_cascade():
         state.close()
 
     run(main())
+
+
+def test_governance_reorg_rollback():
+    """remove_blocks across vote/registration blocks must restore every
+    governance table to its pre-block state (the reorg restore routes
+    outputs back via _OUTPUT_TABLE; reference database.py:146-169), and
+    the full-state fingerprint must match a from-scratch replay."""
+
+    async def main():
+        state = ChainState()
+        manager = BlockManager(state, sig_backend="host")
+        builder = WalletBuilder(state)
+        actors = make_actors()
+        d_g, a_g = actors["genesis"]
+        d_v, a_v = actors["validator"]
+        d_d, a_d = actors["delegate"]
+        for _ in range(40):
+            await mine_block(manager, state, a_g)
+        await push(state, await builder.create_transaction_to_send_multiple_wallet(
+            d_g, [a_v, a_d], ["111", "21"]))
+        await mine_block(manager, state, a_g, include_pending=True)
+        for d in (d_v, d_d):
+            await push(state, await builder.create_stake_transaction(d, "10"))
+        await mine_block(manager, state, a_g, include_pending=True)
+        await push(state, await builder.create_validator_registration_transaction(d_v))
+        await mine_block(manager, state, a_g, include_pending=True)
+
+        pre_vote_fp = await state.get_full_state_hash()
+        pre_power = await state.get_delegates_voting_power(a_d)
+        vote_block_id = await state.get_next_block_id()
+
+        await push(state, await builder.create_voting_transaction(d_d, 10, a_v))
+        await mine_block(manager, state, a_g, include_pending=True)
+        assert await state.get_validators_stake(a_v) == 10
+        assert await state.get_delegates_voting_power(a_d) == []
+
+        # reorg the vote block away: the ballot row disappears and the
+        # delegate's voting-power output is restored
+        await state.remove_blocks(vote_block_id)
+        assert await state.get_full_state_hash() == pre_vote_fp
+        assert await state.get_delegates_voting_power(a_d) == pre_power
+        assert await state.get_validators_stake(a_v) == 0
+        assert await state.get_votes_by_voter("validators_ballot", a_d) == []
+
+        # and the remaining chain still replays cleanly
+        await state.rebuild_utxos()
+        assert await state.get_full_state_hash() == pre_vote_fp
+        state.close()
+
+    run(main())
