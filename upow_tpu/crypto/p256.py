@@ -133,12 +133,19 @@ _DIGITS = 256 // _WINDOW  # 64 ladder iterations
 
 
 def _scalar_digits(xs: Sequence[int]) -> np.ndarray:
-    """Host bigints -> (64, N) int32 w=4 window digits, MSB digit first."""
-    out = np.zeros((_DIGITS, len(xs)), dtype=np.int32)
-    for j, x in enumerate(xs):
-        for k in range(_DIGITS):
-            out[_DIGITS - 1 - k, j] = (x >> (_WINDOW * k)) & 0xF
-    return out
+    """Host bigints -> (64, N) int32 w=4 window digits, MSB digit first.
+
+    Vectorized via per-int ``to_bytes`` + one numpy nibble split (the
+    per-digit Python loop was ~0.3 s per 8k batch)."""
+    n = len(xs)
+    if n == 0:
+        return np.zeros((_DIGITS, 0), dtype=np.int32)
+    raw = b"".join(x.to_bytes(32, "little") for x in xs)
+    by = np.frombuffer(raw, dtype=np.uint8).reshape(n, 32).astype(np.int32)
+    nibbles = np.empty((n, 64), dtype=np.int32)  # nibble k = (x >> 4k) & 0xF
+    nibbles[:, 0::2] = by & 0xF
+    nibbles[:, 1::2] = by >> 4
+    return np.ascontiguousarray(nibbles[:, ::-1].T)  # MSB digit first
 
 
 def _g_window_table() -> np.ndarray:
