@@ -189,8 +189,11 @@ def test_verify_batch_empty():
                     reason="pallas-interpret ladder is a ~2 min compile; "
                            "set UPOW_SLOW_TESTS=1 to include")
 def test_pallas_ladder_matches_host():
-    """The VMEM-resident Pallas verify kernel (TPU production path) in
-    interpret mode against host ECDSA, valid + invalid lanes."""
+    """The stacked-layout Pallas verify kernel in interpret mode against
+    host ECDSA, valid + invalid lanes.  (The production limb-list kernel
+    traces ~10x more ops — interpret mode is impractical for it; its
+    field/point math is covered by the limb-list differentials below and
+    the assembled kernel by bench_suite config 3 on real TPU.)"""
     msgs, sigs, pubs = [], [], []
     for i in range(8):
         d, pub = curve.keygen(rng=5000 + i)
@@ -205,17 +208,157 @@ def test_pallas_ladder_matches_host():
     import hashlib
 
     digests = [hashlib.sha256(m).digest() for m in msgs]
-    orig = p256._verify_device_pallas
+    stacked = p256._verify_device_pallas_stacked
 
     def interp(*a, **kw):
         kw["interpret"] = True
-        return orig(*a, **kw)
+        kw["tile"] = 128
+        return stacked(*a, **kw)
 
+    orig = p256._verify_device_pallas
     try:
         p256._verify_device_pallas = interp
+        p256.PALLAS_STRICT = True  # a kernel failure must FAIL, not fall back
         got = p256.verify_batch_prehashed(
-            digests, sigs, pubs, pad_block=128, backend="pallas")
+            digests, sigs, pubs, pad_block=128, backend="pallas",
+            scalar_prep="host")
     finally:
+        p256.PALLAS_STRICT = False
         p256._verify_device_pallas = orig
     want = [curve.verify(sig, m, pk) for sig, m, pk in zip(sigs, msgs, pubs)]
     assert list(got) == want
+
+
+# --- device-side scalar prep ----------------------------------------------
+
+def test_digits_from_limbs_matches_host():
+    xs = [rng.randrange(CURVE_N) for _ in range(12)] + [0, 1, CURVE_N - 1]
+    limbs = np.asarray(fp.ints_to_limbs(xs))
+    got = np.asarray(p256._digits_from_limbs(limbs))
+    want = p256._scalar_digits(xs)
+    assert np.array_equal(got, want)
+
+
+def test_mod_n_inversion_matches_pow():
+    ns = p256._NS
+    xs = [rng.randrange(1, CURVE_N) for _ in range(6)] + [1, CURVE_N - 1]
+    x_m = fp.FE(np.asarray(fp.ints_to_limbs([fp.to_mont(x, ns) for x in xs])),
+                p256._SCALAR_BOUND)
+    inv_m = p256._mod_n_inv_mont(x_m)
+    got = fp.limbs_to_ints(np.asarray(fp.canon(inv_m, ns)))
+    want = [fp.to_mont(pow(x, -1, CURVE_N), ns) for x in xs]
+    assert got == want
+
+
+@pytest.mark.skipif(not os.environ.get("UPOW_SLOW_TESTS"),
+                    reason="composed prep+ladder program is a ~1 min CPU "
+                           "execute; set UPOW_SLOW_TESTS=1 to include "
+                           "(the TPU path is exercised by bench_suite)")
+def test_device_scalar_prep_full_differential():
+    """scalar_prep="device" (the TPU production path: inversion, u1/u2,
+    Montgomery conversion, on-curve and digit extraction all on device)
+    must agree with host prep and the host curve oracle — including
+    encodings the host path short-circuits before the device sees."""
+    from upow_tpu.core.constants import CURVE_GX, CURVE_GY
+
+    cases = []
+    for i in range(10):
+        d, pub = curve.keygen(rng=900 + i)
+        m = bytes([i]) * 11
+        r, s = curve.sign(m, d)
+        cases.append((m, (r, s), pub))
+    d0, pub0 = curve.keygen(rng=77)
+    m0 = b"prep"
+    r0, s0 = curve.sign(m0, d0)
+    cases += [
+        (m0, (0, s0), pub0),
+        (m0, (r0, 0), pub0),
+        (m0, (CURVE_N, s0), pub0),
+        (m0, (r0, CURVE_N + 5), pub0),
+        (m0, (r0, s0), (0, 0)),
+        (m0, (r0, s0), (CURVE_GX, CURVE_GY + 1)),   # off-curve
+        (m0, (r0, s0), (CURVE_P + 1, 1)),           # coordinate >= p, off-curve
+        (m0, (r0, CURVE_N - s0), pub0),             # malleability twin: valid
+        # consensus parity: fastecdsa computes mod p, so (x+p, y) encodes
+        # the same on-curve point and the reference ACCEPTS it — both our
+        # paths must too (host reduces via to_mont/is_on_curve, device via
+        # Montgomery reduction; coord() handles the >= 2^256 packing)
+        (m0, (r0, s0), (pub0[0] + CURVE_P, pub0[1])),
+        (m0, (r0, s0), (pub0[0], pub0[1] + CURVE_P)),
+        # hostile API inputs: negative / oversized ints must yield False,
+        # not an exception (the host path's documented short-circuit)
+        (m0, (-1, s0), pub0),
+        (m0, (r0, 1 << 280), pub0),
+        (m0, (r0, s0), (-pub0[0], pub0[1])),
+    ]
+    msgs = [c[0] for c in cases]
+    sigs = [c[1] for c in cases]
+    pubs = [c[2] for c in cases]
+    import hashlib
+
+    digests = [hashlib.sha256(m).digest() for m in msgs]
+    want = [curve.verify(sig, m, pk) for sig, m, pk in zip(sigs, msgs, pubs)]
+    got = p256.verify_batch_prehashed(digests, sigs, pubs, pad_block=8,
+                                      backend="jnp", scalar_prep="device")
+    assert list(got) == want
+
+
+# --- limb-list layout (Pallas kernel data path) ----------------------------
+# The list ops are plain jnp functions; testing them directly covers the
+# kernel's field arithmetic without a (slow) interpret-mode pallas_call.
+# The assembled kernel itself is exercised on real TPU by bench_suite
+# config 3 and the driver's compile gate.
+
+def _to_fl(xs, bound):
+    limbs = fp.ints_to_limbs(xs)
+    return fp.l_wrap([np.asarray(limbs[i]) for i in range(fp.NUM_LIMBS)],
+                     bound)
+
+
+def _fl_ints(a, fs=None):
+    limbs = np.stack([np.asarray(x) for x in fp.l_canon(a, fs or _FS)])
+    return fp.limbs_to_ints(limbs)
+
+
+def test_limb_list_field_ops_match_bigint():
+    xs = [rng.randrange(CURVE_P) for _ in range(6)] + [0, 1, CURVE_P - 1]
+    ys = [rng.randrange(CURVE_P) for _ in range(6)] + [CURVE_P - 1, 1,
+                                                       CURVE_P - 1]
+    a = _to_fl([fp.to_mont(x, _FS) for x in xs], CURVE_P)
+    b = _to_fl([fp.to_mont(y, _FS) for y in ys], CURVE_P)
+    mont = lambda v: fp.to_mont(v % CURVE_P, _FS)
+    assert _fl_ints(fp.l_mont_mul(a, b, _FS)) == [
+        mont(x * y) for x, y in zip(xs, ys)]
+    assert _fl_ints(fp.l_add(a, b)) == [
+        (mont(x) + mont(y)) % CURVE_P for x, y in zip(xs, ys)]
+    assert _fl_ints(fp.l_sub(a, b, _FS)) == [
+        (mont(x) - mont(y)) % CURVE_P for x, y in zip(xs, ys)]
+    zero = _to_fl([0, CURVE_P], CURVE_P + 1)
+    nz = _to_fl([1, CURVE_P - 1], CURVE_P)
+    assert list(np.asarray(fp.l_is_zero_mod_p(zero, _FS))) == [True, True]
+    assert list(np.asarray(fp.l_is_zero_mod_p(nz, _FS))) == [False, False]
+
+
+def test_limb_list_point_add_matches_stacked():
+    G = curve.G
+    P1 = curve.point_mul(rng.randrange(1, CURVE_N), G)
+    neg = (P1[0], CURVE_P - P1[1])
+    cases = [(P1, P1), (P1, neg), (None, P1), (G, G), (None, None), (P1, G)]
+
+    def pt_fl(points):
+        xs = [fp.to_mont(0 if p is None else p[0], _FS) for p in points]
+        ys = [fp.to_mont(1 if p is None else p[1], _FS) for p in points]
+        zs = [fp.to_mont(0 if p is None else 1, _FS) for p in points]
+        return tuple(_to_fl(v, CURVE_P) for v in (xs, ys, zs))
+
+    A, B = pt_fl([c[0] for c in cases]), pt_fl([c[1] for c in cases])
+    b_m = fp.l_const(p256._B_M, np.asarray(A[0].limbs[0]).shape, CURVE_P)
+    X, Y, Z = (_fl_ints(c) for c in p256._point_add_complete_l(A, B, b_m))
+    rinv = pow(1 << fp.R_BITS, -1, CURVE_P)
+    got = []
+    for x, y, z in zip(X, Y, Z):
+        x, y, z = (v * rinv % CURVE_P for v in (x, y, z))
+        got.append(None if z == 0 else
+                   (x * pow(z, -1, CURVE_P) % CURVE_P,
+                    y * pow(z, -1, CURVE_P) % CURVE_P))
+    assert got == [curve.point_add(a_, b_) for a_, b_ in cases]

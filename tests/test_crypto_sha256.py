@@ -97,7 +97,9 @@ def test_pallas_matches_jnp(difficulty):
     template = make_template(prefix)
     prev_hash = _rand_bytes(32).hex()
     spec = target_spec(prev_hash, difficulty)
-    batch = 8192
+    # interpret mode executes per-op Python: keep the batch small, but
+    # larger than one tile (tile_rows=8 -> 1024 lanes) to exercise the grid
+    batch = 2048
     a = int(pow_search_jnp(template, spec, nonce_base=0, batch=batch))
     b = int(pow_search_pallas(template, spec, nonce_base=0, batch=batch,
                               tile_rows=8, interpret=True))

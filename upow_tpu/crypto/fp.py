@@ -233,6 +233,125 @@ def mont_mul(a: FE, b: FE, fs: FieldSpec) -> FE:
     return FE(out, a.bound * b.bound // (1 << R_BITS) + 2 * fs.p)
 
 
+# --- limb-list variant (Pallas kernel layout) ------------------------------
+# Same arithmetic, but an element is a Python TUPLE of 21 per-limb arrays
+# (each typically an (8, 128) int32 tile = 1024 batch lanes).  Limb shifts
+# become Python indexing — zero data movement — where the stacked (L, N)
+# layout pays a concatenate per shifted add.  This is the layout the
+# VMEM-resident ladder kernel runs in; bounds are tracked identically.
+
+
+@dataclass(frozen=True)
+class FL:
+    """Field-element batch as a limb tuple + static value bound."""
+
+    limbs: tuple  # length NUM_LIMBS, arrays of identical shape
+    bound: int
+
+    def __post_init__(self):
+        assert self.bound <= _BOUND_CAP, (
+            f"fp bound overflow: {self.bound.bit_length()} bits")
+
+
+def l_wrap(limbs, bound: int) -> FL:
+    return FL(tuple(limbs), bound)
+
+
+def l_const(x: int, shape, bound: int) -> FL:
+    limbs = int_to_limbs(x)
+    return FL(tuple(jnp.full(shape, int(l), dtype=jnp.int32) for l in limbs),
+              bound)
+
+
+def _l_sweep(t: list, rounds: int) -> list:
+    """In-place-style carry sweep over a limb list (top carry provably 0)."""
+    t = list(t)
+    for _ in range(rounds):
+        carry = None
+        for i in range(len(t)):
+            v = t[i] if carry is None else t[i] + carry
+            carry = v >> LIMB_BITS
+            t[i] = v & LIMB_MASK
+    return t
+
+
+def l_add(a: FL, b: FL) -> FL:
+    t = [x + y for x, y in zip(a.limbs, b.limbs)]
+    return FL(tuple(_l_sweep(t, 1)), a.bound + b.bound)
+
+
+def l_sub(a: FL, b: FL, fs: FieldSpec) -> FL:
+    K = _pow2_p_multiple(b.bound, fs.p)
+    k_limbs = int_to_limbs(K)
+    limbs = []
+    c = None
+    for i in range(NUM_LIMBS):
+        v = int(k_limbs[i]) - b.limbs[i] + (0 if c is None else c)
+        limbs.append(v & LIMB_MASK)
+        c = v >> LIMB_BITS
+    t = [x + y for x, y in zip(a.limbs, limbs)]
+    return FL(tuple(_l_sweep(t, 1)), a.bound + K)
+
+
+def l_mont_mul(a: FL, b: FL, fs: FieldSpec) -> FL:
+    """Montgomery product in limb-list form: the anti-diagonal accumulation
+    is Python indexing (t[i+j] += a_i·b_j) — no concatenates, every MAC one
+    full-tile VPU op."""
+    L = NUM_LIMBS
+    t = [None] * (2 * L)
+    for i in range(L):
+        ai = a.limbs[i]
+        for j in range(L):
+            p_ij = ai * b.limbs[j]
+            k = i + j
+            t[k] = p_ij if t[k] is None else t[k] + p_ij
+    t[2 * L - 1] = jnp.zeros_like(t[0])  # index 2L-1 never receives a product
+    t = _l_sweep(t, 3)
+    for i in range(L):
+        m = (t[i] * fs.pinv) & LIMB_MASK
+        for j in range(L):
+            t[i + j] = t[i + j] + m * fs.p_limbs[j]
+        t[i + 1] = t[i + 1] + (t[i] >> LIMB_BITS)
+    out = _l_sweep(t[L:], 3)
+    return FL(tuple(out), a.bound * b.bound // (1 << R_BITS) + 2 * fs.p)
+
+
+def l_canon(a: FL, fs: FieldSpec) -> list:
+    limbs = []
+    c = None
+    for i in range(NUM_LIMBS):
+        v = a.limbs[i] if c is None else a.limbs[i] + c
+        limbs.append(v & LIMB_MASK)
+        c = v >> LIMB_BITS
+    k = 1
+    while k * fs.p < a.bound:
+        k <<= 1
+    while k >= 1:
+        limbs = _l_cond_sub(limbs, k * fs.p)
+        k //= 2
+    return limbs
+
+
+def _l_cond_sub(t: list, m: int) -> list:
+    mc = int_to_limbs(m)
+    limbs = []
+    c = None
+    for i in range(NUM_LIMBS):
+        v = t[i] - int(mc[i]) + (0 if c is None else c)
+        limbs.append(v & LIMB_MASK)
+        c = v >> LIMB_BITS
+    ge = c == 0
+    return [jnp.where(ge, d, orig) for d, orig in zip(limbs, t)]
+
+
+def l_is_zero_mod_p(a: FL, fs: FieldSpec):
+    limbs = l_canon(a, fs)
+    z = limbs[0] == 0
+    for i in range(1, NUM_LIMBS):
+        z = z & (limbs[i] == 0)
+    return z
+
+
 def canon(a: FE, fs: FieldSpec):
     """Exact canonical reduction to [0, p) with canonical limbs.
 
