@@ -128,6 +128,40 @@ def config3_batch_verify(seconds: float):
     rate = reps * 8192 / (time.perf_counter() - t0)
     _emit(f"verify_8k_batch_{_platform()}", rate, "sigs/s", base_rate)
 
+    # kernel-only split (host prep + transfer excluded): how much of the
+    # end-to-end gap is the device program vs the host pipeline
+    import jax
+
+    import upow_tpu.crypto.p256 as P
+
+    captured = {}
+    orig_pallas, orig_jnp = P._prep_and_verify_pallas, P._prep_and_verify_jnp
+
+    def cap_pallas(*a, **kw):
+        captured["call"] = lambda: orig_pallas(*a, **kw)
+        return orig_pallas(*a, **kw)
+
+    def cap_jnp(*a, **kw):
+        captured["call"] = lambda: orig_jnp(*a, **kw)
+        return orig_jnp(*a, **kw)
+
+    P._prep_and_verify_pallas, P._prep_and_verify_jnp = cap_pallas, cap_jnp
+    try:
+        p256.verify_batch_prehashed(digests, sigs, pubs, pad_block=8192,
+                                    scalar_prep="device")
+    finally:
+        P._prep_and_verify_pallas, P._prep_and_verify_jnp = (orig_pallas,
+                                                             orig_jnp)
+    if "call" in captured:
+        jax.block_until_ready(captured["call"]())
+        t0 = time.perf_counter()
+        reps = 0
+        while time.perf_counter() - t0 < seconds:
+            jax.block_until_ready(captured["call"]())
+            reps += 1
+        krate = reps * 8192 / (time.perf_counter() - t0)
+        _emit(f"verify_8k_kernel_{_platform()}", krate, "sigs/s", base_rate)
+
 
 def config4_replay(seconds: float):
     """Full-chain replay: mine a chain with sends, wipe the UTXO tables,

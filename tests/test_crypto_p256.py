@@ -297,7 +297,14 @@ def test_device_scalar_prep_full_differential():
     import hashlib
 
     digests = [hashlib.sha256(m).digest() for m in msgs]
+    # oversized digest (sha512-length): device path must reduce mod n like
+    # the host's z*w % n, not raise
+    digests.append(hashlib.sha512(m0).digest())
+    sigs.append((r0, s0)); pubs.append(pub0)
     want = [curve.verify(sig, m, pk) for sig, m, pk in zip(sigs, msgs, pubs)]
+    want.append(bool(p256.verify_batch_prehashed(
+        [hashlib.sha512(m0).digest()], [(r0, s0)], [pub0], pad_block=8,
+        backend="jnp", scalar_prep="host")[0]))
     got = p256.verify_batch_prehashed(digests, sigs, pubs, pad_block=8,
                                       backend="jnp", scalar_prep="device")
     assert list(got) == want

@@ -649,6 +649,16 @@ def _pallas_or_jnp(pallas_thunk, jnp_thunk) -> np.ndarray:
         return np.asarray(jnp_thunk())
 
 
+def _pick_tile(padded: int, cap: int = 1024) -> int:
+    """Largest 128-multiple divisor of ``padded`` that is <= ``cap``
+    (``padded`` is always a multiple of 128 on the pallas path)."""
+    rows = padded // 128
+    for k in range(min(cap // 128, rows), 0, -1):
+        if rows % k == 0:
+            return 128 * k
+    return 128
+
+
 def _pad_to_block(n: int, block: int = 128) -> int:
     """Round up to a power-of-two multiple of ``block`` (>= block).
 
@@ -750,7 +760,11 @@ def verify_batch_prehashed(
             # ints the way Python % does on the host oracle path
             return x if 0 <= x < (1 << 256) else x % CURVE_P
 
-        zs = [int.from_bytes(d, "big") for d in digests]
+        # u1 depends only on z mod n, so oversized digests (a direct API
+        # caller hashing with sha512, say) reduce exactly like the host's
+        # z*w % n — never an exception where the host returns a verdict
+        zs = [z if z < (1 << 256) else z % CURVE_N
+              for z in (int.from_bytes(d, "big") for d in digests)]
         rs = [sig[0] for sig in signatures]
         ss = [sig[1] for sig in signatures]
         qxs = [coord(pk[0]) for pk in pubkeys]
@@ -770,7 +784,7 @@ def verify_batch_prehashed(
         if backend == "pallas":
             out = _pallas_or_jnp(
                 lambda: _prep_and_verify_pallas(*inputs,
-                                                tile=min(1024, padded)),
+                                                tile=_pick_tile(padded)),
                 lambda: _prep_and_verify_jnp(*inputs))
         else:
             if mesh is not None:
@@ -821,7 +835,7 @@ def verify_batch_prehashed(
         out = _pallas_or_jnp(
             lambda: _verify_device_pallas(
                 digits(u1s), digits(u2s), arr(qxs), arr(qys), arr(rms),
-                arr(rnms), flags, tile=min(1024, padded)),
+                arr(rnms), flags, tile=_pick_tile(padded)),
             lambda: _verify_device(
                 digits(u1s), digits(u2s), arr(qxs), arr(qys), arr(rms),
                 arr(rnms),

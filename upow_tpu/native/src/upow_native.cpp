@@ -14,6 +14,9 @@
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#if defined(__x86_64__) && defined(__GNUC__)
+#include <immintrin.h>  // SHA-NI intrinsics (guarded per-function below)
+#endif
 
 // ---------------------------------------------------------------- sha256 --
 
@@ -62,7 +65,71 @@ static void compress(uint32_t state[8], const uint8_t block[64]) {
   state[4] += e; state[5] += f; state[6] += g; state[7] += h;
 }
 
+#if defined(__x86_64__) && defined(__GNUC__)
+// SHA-NI compression (x86 SHA extensions): ~10x the portable loop on one
+// core.  Compiled with a per-function target attribute so the rest of the
+// library needs no -m flags; selected at runtime via cpuid.
+__attribute__((target("sha,sse4.1")))
+static void compress_shani(uint32_t state[8], const uint8_t block[64]) {
+  const __m128i MASK = _mm_set_epi64x(0x0c0d0e0f08090a0bULL,
+                                      0x0405060700010203ULL);
+  __m128i TMP    = _mm_loadu_si128((const __m128i*)&state[0]);
+  __m128i STATE1 = _mm_loadu_si128((const __m128i*)&state[4]);
+  TMP    = _mm_shuffle_epi32(TMP, 0xB1);             // CDAB
+  STATE1 = _mm_shuffle_epi32(STATE1, 0x1B);          // EFGH
+  __m128i STATE0 = _mm_alignr_epi8(TMP, STATE1, 8);  // ABEF
+  STATE1 = _mm_blend_epi16(STATE1, TMP, 0xF0);       // CDGH
+  const __m128i ABEF_SAVE = STATE0, CDGH_SAVE = STATE1;
+
+  __m128i msgs[4];
+  for (int i = 0; i < 4; i++)
+    msgs[i] = _mm_shuffle_epi8(
+        _mm_loadu_si128((const __m128i*)(block + 16 * i)), MASK);
+
+  for (int i = 0; i < 16; i++) {
+    __m128i wk = _mm_add_epi32(
+        msgs[i & 3], _mm_loadu_si128((const __m128i*)&K[4 * i]));
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, wk);
+    wk = _mm_shuffle_epi32(wk, 0x0E);
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, wk);
+    if (i < 12) {  // schedule W[4(i+4) ..] from W[4i ..]
+      __m128i tmp = _mm_alignr_epi8(msgs[(i + 3) & 3], msgs[(i + 2) & 3], 4);
+      msgs[i & 3] = _mm_sha256msg2_epu32(
+          _mm_add_epi32(_mm_sha256msg1_epu32(msgs[i & 3], msgs[(i + 1) & 3]),
+                        tmp),
+          msgs[(i + 3) & 3]);
+    }
+  }
+
+  STATE0 = _mm_add_epi32(STATE0, ABEF_SAVE);
+  STATE1 = _mm_add_epi32(STATE1, CDGH_SAVE);
+  TMP    = _mm_shuffle_epi32(STATE0, 0x1B);          // FEBA
+  STATE1 = _mm_shuffle_epi32(STATE1, 0xB1);          // DCHG
+  STATE0 = _mm_blend_epi16(TMP, STATE1, 0xF0);       // DCBA
+  STATE1 = _mm_alignr_epi8(STATE1, TMP, 8);          // HGFE
+  _mm_storeu_si128((__m128i*)&state[0], STATE0);
+  _mm_storeu_si128((__m128i*)&state[4], STATE1);
+}
+
+static bool shani_available() {
+  __builtin_cpu_init();
+  return __builtin_cpu_supports("sha") && __builtin_cpu_supports("sse4.1");
+}
+#else
+static void compress_shani(uint32_t state[8], const uint8_t block[64]) {
+  compress(state, block);
+}
+static bool shani_available() { return false; }
+#endif
+
+typedef void (*compress_fn)(uint32_t[8], const uint8_t[64]);
+
+static compress_fn pick_compress() {
+  return shani_available() ? compress_shani : compress;
+}
+
 static void digest(const uint8_t* msg, size_t len, uint8_t out[32]) {
+  const compress_fn compress = pick_compress();
   uint32_t state[8];
   memcpy(state, H0, sizeof(H0));
   size_t off = 0;
@@ -95,10 +162,11 @@ extern "C" uint32_t upow_pow_search(const uint8_t* prefix, size_t prefix_len,
                                     const uint8_t* target_nibbles,
                                     size_t n_target, uint32_t charset,
                                     uint32_t start, uint32_t count) {
+  const sha256::compress_fn compress = sha256::pick_compress();
   uint32_t mid[8];
   memcpy(mid, sha256::H0, sizeof(mid));
   size_t n_full = prefix_len / 64;
-  for (size_t i = 0; i < n_full; i++) sha256::compress(mid, prefix + 64 * i);
+  for (size_t i = 0; i < n_full; i++) compress(mid, prefix + 64 * i);
   size_t rem = prefix_len - 64 * n_full;
   size_t total = prefix_len + 4;
   // same bound as make_template: rem + nonce(4) + 0x80 must fit before the
@@ -111,16 +179,16 @@ extern "C" uint32_t upow_pow_search(const uint8_t* prefix, size_t prefix_len,
   uint64_t bits = uint64_t(total) * 8;
   for (int i = 0; i < 8; i++) tail[63 - i] = uint8_t(bits >> (8 * i));
 
+  uint8_t blk[64];
+  memcpy(blk, tail, 64);  // only the 4 nonce bytes change per iteration
   for (uint64_t n = start; n < uint64_t(start) + count; n++) {
     uint32_t state[8];
     memcpy(state, mid, sizeof(mid));
-    uint8_t blk[64];
-    memcpy(blk, tail, 64);
     blk[rem] = uint8_t(n);
     blk[rem + 1] = uint8_t(n >> 8);
     blk[rem + 2] = uint8_t(n >> 16);
     blk[rem + 3] = uint8_t(n >> 24);
-    sha256::compress(state, blk);
+    compress(state, blk);
     bool ok = true;
     for (size_t i = 0; i < n_target && ok; i++) {
       uint32_t nib = (state[i / 8] >> (28 - 4 * (i % 8))) & 0xF;
