@@ -369,3 +369,91 @@ def test_limb_list_point_add_matches_stacked():
                    (x * pow(z, -1, CURVE_P) % CURVE_P,
                     y * pow(z, -1, CURVE_P) % CURVE_P))
     assert got == [curve.point_add(a_, b_) for a_, b_ in cases]
+
+
+def test_limb_list_mont_sqr_matches_mul():
+    xs = [rng.randrange(CURVE_P) for _ in range(8)] + [0, 1, CURVE_P - 1]
+    a = _to_fl([fp.to_mont(x, _FS) for x in xs], CURVE_P)
+    got = _fl_ints(fp.l_mont_sqr(a, _FS))
+    want = _fl_ints(fp.l_mont_mul(a, a, _FS))
+    assert got == want
+    # lazy (unreduced) inputs square correctly too
+    b = fp.l_add(a, a)
+    assert _fl_ints(fp.l_mont_sqr(b, _FS)) == _fl_ints(fp.l_mont_mul(b, b, _FS))
+
+
+def test_limb_list_point_dbl_matches_add():
+    G = curve.G
+    P1 = curve.point_mul(rng.randrange(1, CURVE_N), G)
+    cases = [P1, G, None, curve.point_mul(2, G)]
+
+    def pt_fl(points):
+        xs = [fp.to_mont(0 if p is None else p[0], _FS) for p in points]
+        ys = [fp.to_mont(1 if p is None else p[1], _FS) for p in points]
+        zs = [fp.to_mont(0 if p is None else 1, _FS) for p in points]
+        return tuple(_to_fl(v, CURVE_P) for v in (xs, ys, zs))
+
+    A = pt_fl(cases)
+    b_m = fp.l_const(p256._B_M, np.asarray(A[0].limbs[0]).shape, CURVE_P)
+    dbl = p256._point_dbl_complete_l(A, b_m)
+    add = p256._point_add_complete_l(A, A, b_m)
+    for c_d, c_a in zip(dbl, add):
+        assert _fl_ints(c_d) == _fl_ints(c_a)
+    # and folding 4 doublings == [16]P through the host oracle
+    cur = A
+    for _ in range(4):
+        cur = tuple(fp.l_wrap(c.limbs, p256._COORD_BOUND) for c in
+                    p256._point_dbl_complete_l(cur, b_m))
+    X, Y, Z = (_fl_ints(c) for c in cur)
+    rinv = pow(1 << fp.R_BITS, -1, CURVE_P)
+    for i, pt in enumerate(cases):
+        x, y, z = (v * rinv % CURVE_P for v in (X[i], Y[i], Z[i]))
+        want = curve.point_mul(16, pt) if pt is not None else None
+        if z == 0:
+            assert want is None
+        else:
+            zi = pow(z, -1, CURVE_P)
+            assert (x * zi % CURVE_P, y * zi % CURVE_P) == want
+
+
+def test_device_prep_input_sanitation_fast():
+    """The device-prep branch's host-side packing (z mod n for oversized
+    digests, coord mod p, sane() clamps) — checked against the limb
+    arrays actually shipped, with the device program stubbed out so the
+    test costs no XLA compile."""
+    import hashlib
+
+    d0, pub0 = curve.keygen(rng=31)
+    m0 = b"sanitize"
+    r0, s0 = curve.sign(m0, d0)
+    digests = [hashlib.sha512(m0).digest(),      # z >= 2^256 -> z mod n
+               hashlib.sha256(m0).digest()]
+    sigs = [(r0, s0), (-1, 1 << 280)]            # hostile r/s -> sane() 0
+    pubs = [(pub0[0] + (1 << 257), -5), pub0]    # coords -> mod p
+
+    captured = {}
+
+    def stub(z, r, s, qx, qy, range_ok, rn_ok):
+        captured.update(z=np.asarray(z), r=np.asarray(r), s=np.asarray(s),
+                        qx=np.asarray(qx), qy=np.asarray(qy),
+                        range_ok=np.asarray(range_ok))
+        import jax.numpy as jnp
+
+        return jnp.zeros(z.shape[1], dtype=bool)
+
+    orig = p256._prep_and_verify_jnp
+    p256._prep_and_verify_jnp = stub
+    try:
+        p256.verify_batch_prehashed(digests, sigs, pubs, pad_block=8,
+                                    backend="jnp", scalar_prep="device")
+    finally:
+        p256._prep_and_verify_jnp = orig
+
+    lane = lambda arr, j: fp.limbs_to_int(arr[:, j])
+    z512 = int.from_bytes(digests[0], "big")
+    assert lane(captured["z"], 0) == z512 % CURVE_N
+    assert lane(captured["z"], 1) == int.from_bytes(digests[1], "big")
+    assert lane(captured["qx"], 0) == (pub0[0] + (1 << 257)) % CURVE_P
+    assert lane(captured["qy"], 0) == (-5) % CURVE_P
+    assert lane(captured["r"], 1) == 0 and lane(captured["s"], 1) == 0
+    assert list(captured["range_ok"][:2]) == [True, False]

@@ -293,6 +293,23 @@ def l_sub(a: FL, b: FL, fs: FieldSpec) -> FL:
     return FL(tuple(_l_sweep(t, 1)), a.bound + K)
 
 
+def _l_mont_reduce(t: list, bound_product: int, fs: FieldSpec) -> FL:
+    """Shared tail of the limb-list Montgomery entry points: sweep the
+    double-width accumulator, run the 21 reduction rounds, sweep the top
+    half.  ``t`` rows may be None (rows no product reached)."""
+    L = NUM_LIMBS
+    t = [jnp.zeros_like(next(x for x in t if x is not None)) if r is None
+         else r for r in t]
+    t = _l_sweep(t, 3)
+    for i in range(L):
+        m = (t[i] * fs.pinv) & LIMB_MASK
+        for j in range(L):
+            t[i + j] = t[i + j] + m * fs.p_limbs[j]
+        t[i + 1] = t[i + 1] + (t[i] >> LIMB_BITS)
+    out = _l_sweep(t[L:], 3)
+    return FL(tuple(out), bound_product // (1 << R_BITS) + 2 * fs.p)
+
+
 def l_mont_mul(a: FL, b: FL, fs: FieldSpec) -> FL:
     """Montgomery product in limb-list form: the anti-diagonal accumulation
     is Python indexing (t[i+j] += a_i·b_j) — no concatenates, every MAC one
@@ -305,15 +322,34 @@ def l_mont_mul(a: FL, b: FL, fs: FieldSpec) -> FL:
             p_ij = ai * b.limbs[j]
             k = i + j
             t[k] = p_ij if t[k] is None else t[k] + p_ij
-    t[2 * L - 1] = jnp.zeros_like(t[0])  # index 2L-1 never receives a product
-    t = _l_sweep(t, 3)
+    return _l_mont_reduce(t, a.bound * b.bound, fs)
+
+
+def l_mont_sqr(a: FL, fs: FieldSpec) -> FL:
+    """Montgomery square: the schoolbook product's symmetry halves the
+    cross-term MACs (t[i+j] gets 2·aᵢaⱼ once instead of aᵢaⱼ twice; the
+    factor 2 is applied once per row after accumulation).
+
+    Bound safety: a row collects ≤10 doubled cross products (< 2²⁷ each)
+    plus one square (< 2²⁶) — under 2³¹ in int32, same margin as
+    :func:`l_mont_mul`'s 21-term accumulation."""
+    L = NUM_LIMBS
+    cross = [None] * (2 * L)  # Σ_{i<j} a_i·a_j per row (to be doubled)
     for i in range(L):
-        m = (t[i] * fs.pinv) & LIMB_MASK
-        for j in range(L):
-            t[i + j] = t[i + j] + m * fs.p_limbs[j]
-        t[i + 1] = t[i + 1] + (t[i] >> LIMB_BITS)
-    out = _l_sweep(t[L:], 3)
-    return FL(tuple(out), a.bound * b.bound // (1 << R_BITS) + 2 * fs.p)
+        ai = a.limbs[i]
+        for j in range(i + 1, L):
+            k = i + j
+            p_ij = ai * a.limbs[j]
+            cross[k] = p_ij if cross[k] is None else cross[k] + p_ij
+    t = [None] * (2 * L)
+    for k in range(2 * L):
+        if cross[k] is not None:
+            t[k] = cross[k] + cross[k]
+    for i in range(L):  # diagonal squares
+        k = 2 * i
+        sq = a.limbs[i] * a.limbs[i]
+        t[k] = sq if t[k] is None else t[k] + sq
+    return _l_mont_reduce(t, a.bound * a.bound, fs)
 
 
 def l_canon(a: FL, fs: FieldSpec) -> list:

@@ -84,6 +84,73 @@ def _point_add_complete_l(P1, P2, b_m):
     )
 
 
+def _point_dbl_complete_l(P, b_m):
+    """Limb-list doubling via :func:`_point_dbl_rcb16` (the layout the
+    Pallas kernel runs in).  The stacked jnp path deliberately does NOT
+    route doublings through a second program: ``_verify_device`` keeps a
+    single scanned add site precisely to bound XLA:CPU compile time
+    (see its docstring), and a dedicated doubling would double it."""
+    fs = _FS
+    return _point_dbl_rcb16(
+        P, b_m,
+        mul=lambda x, y: fp.l_mont_mul(x, y, fs),
+        sqr=lambda x: fp.l_mont_sqr(x, fs),
+        add_=fp.l_add,
+        sub_=lambda x, y: fp.l_sub(x, y, fs),
+    )
+
+
+def _point_dbl_rcb16(P, b_m, mul, sqr, add_, sub_):
+    """Doubling through the SAME RCB16 Algorithm-4 sequence as
+    :func:`_point_add_rcb16` with the six same-operand products routed to
+    the Montgomery square (~40% cheaper MAC count each).  Not a different
+    formula — completeness and the bound discipline carry over verbatim
+    from the addition program."""
+    X1, Y1, Z1 = P
+
+    t0 = sqr(X1)            # X1·X2
+    t1 = sqr(Y1)            # Y1·Y2
+    t2 = sqr(Z1)            # Z1·Z2
+    t3 = add_(X1, Y1)
+    t3 = sqr(t3)            # (X1+Y1)·(X2+Y2)
+    t4 = add_(t0, t1)
+    t3 = sub_(t3, t4)
+    t4 = add_(Y1, Z1)
+    t4 = sqr(t4)            # (Y1+Z1)·(Y2+Z2)
+    X3 = add_(t1, t2)
+    t4 = sub_(t4, X3)
+    X3 = add_(X1, Z1)
+    X3 = sqr(X3)            # (X1+Z1)·(X2+Z2)
+    Y3 = add_(t0, t2)
+    Y3 = sub_(X3, Y3)
+    Z3 = mul(b_m, t2)
+    X3 = sub_(Y3, Z3)
+    Z3 = add_(X3, X3)
+    X3 = add_(X3, Z3)
+    Z3 = sub_(t1, X3)
+    X3 = add_(t1, X3)
+    Y3 = mul(b_m, Y3)
+    t1 = add_(t2, t2)
+    t2 = add_(t1, t2)
+    Y3 = sub_(Y3, t2)
+    Y3 = sub_(Y3, t0)
+    t1 = add_(Y3, Y3)
+    Y3 = add_(t1, Y3)
+    t1 = add_(t0, t0)
+    t0 = add_(t1, t0)
+    t0 = sub_(t0, t2)
+    t1 = mul(t4, Y3)
+    t2 = mul(t0, Y3)
+    Y3 = mul(X3, Z3)
+    Y3 = add_(Y3, t2)
+    t2 = mul(t3, X3)
+    X3 = sub_(t2, t1)
+    t2 = mul(t4, Z3)
+    t1 = mul(t3, t0)
+    Z3 = add_(t2, t1)
+    return (X3, Y3, Z3)
+
+
 def _point_add_rcb16(P1, P2, b_m, mul, add_, sub_):
     X1, Y1, Z1 = P1
     X2, Y2, Z2 = P2
@@ -505,7 +572,7 @@ def _ladder_kernel_list(d1_ref, d2_ref, qx_ref, qy_ref, rm_ref, rnm_ref,
 
         def dbl(_, t):
             R = unflatten(t)
-            return flatten(clamp(_point_add_complete_l(R, R, b_m)))
+            return flatten(clamp(_point_dbl_complete_l(R, b_m)))
 
         a = jax.lax.fori_loop(0, _WINDOW, dbl, carry)
 

@@ -125,7 +125,11 @@ static bool shani_available() { return false; }
 typedef void (*compress_fn)(uint32_t[8], const uint8_t[64]);
 
 static compress_fn pick_compress() {
-  return shani_available() ? compress_shani : compress;
+  // cpuid runs once; per-message callers (upow_sha256 on short inputs)
+  // would otherwise pay serializing cpuid leaves per call
+  static const compress_fn picked =
+      shani_available() ? compress_shani : compress;
+  return picked;
 }
 
 static void digest(const uint8_t* msg, size_t len, uint8_t out[32]) {
