@@ -71,3 +71,64 @@ def test_build_job_defaults_genesis():
     assert block_no == 1
     assert hashes == []
     assert job.previous_hash == (18_884_643).to_bytes(32, "little").hex()
+
+
+def test_hang_watchdog_trips_on_stale_heartbeat():
+    """A dead-tunnel dispatch hangs forever; the watchdog must fire once
+    the heartbeat goes stale, and not before while it is refreshed."""
+    import threading
+    import time as _time
+
+    from upow_tpu.mine import miner
+
+    fired = threading.Event()
+    hb = {"t": _time.monotonic()}
+    miner._start_hang_watchdog(hb, limit=1.2, _exit=lambda code: fired.set())
+    # keep the heartbeat fresh: no trip
+    for _ in range(4):
+        _time.sleep(0.4)
+        hb["t"] = _time.monotonic()
+    assert not fired.is_set()
+    # go stale: trips within ~limit + poll interval
+    assert fired.wait(timeout=4.0)
+
+
+def test_supervisor_respawns_hung_child(tmp_path):
+    """End-to-end: a miner child whose backend hangs must be killed by the
+    watchdog with the respawn exit code (3), promptly."""
+    import os
+    import subprocess
+    import sys as _sys
+    import textwrap
+
+    import upow_tpu
+
+    repo = os.path.dirname(os.path.dirname(upow_tpu.__file__))
+    stub = tmp_path / "hang_miner.py"
+    stub.write_text(textwrap.dedent(f"""
+        import sys, time
+        sys.path.insert(0, {repo!r})
+        import upow_tpu.mine.miner as miner
+
+        def fake_fetch(node):
+            return {{"difficulty": "1.0"}}
+
+        def fake_build(info, address):
+            return object(), [], 1
+
+        def hang(job, backend, **kw):
+            time.sleep(600)
+
+        miner.fetch_mining_info = fake_fetch
+        miner.build_job = fake_build
+        miner.mine = hang
+        miner.run("addr", "http://x/", "jnp", 0, ttl=0.5, hang_grace=1.0,
+                  first_round_grace=0.0)
+    """))
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    t0 = __import__("time").monotonic()
+    proc = subprocess.run([_sys.executable, str(stub)], env=env, timeout=60)
+    assert proc.returncode == 3
+    assert __import__("time").monotonic() - t0 < 30

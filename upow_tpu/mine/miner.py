@@ -80,9 +80,40 @@ def select_backend(device: str) -> str:
     raise SystemExit(f"unknown device {device!r}")
 
 
+def _start_hang_watchdog(heartbeat: dict, limit: float, _exit=None):
+    """A device dispatch on a dropped TPU tunnel HANGS (never raises), so
+    the in-loop TTL check can never fire.  This thread hard-exits the
+    process when the heartbeat goes stale; the supervisor (reference
+    miner.py:149-156's outer watchdog) respawns a fresh process — the
+    only reliable recovery once a thread is stuck inside the PJRT client.
+
+    ``heartbeat['limit']`` (optional) overrides ``limit`` — the caller
+    raises it for the first round (cold compile can exceed the steady-
+    state budget) and drops it once progress ticks.
+    """
+    import os
+    import threading
+
+    _exit = _exit or os._exit
+
+    def watch():
+        while True:
+            time.sleep(min(5.0, limit / 4))
+            lim = heartbeat.get("limit", limit)
+            if time.monotonic() - heartbeat["t"] > lim:
+                print(f"no mining progress for {lim:.0f}s — device hang? "
+                      "exiting for respawn", file=sys.stderr, flush=True)
+                _exit(3)
+
+    t = threading.Thread(target=watch, daemon=True, name="miner-watchdog")
+    t.start()
+    return t
+
+
 def run(address: str, node: str, device: str, batch: int, ttl: float,
         shard: tuple = (0, 1), once: bool = False,
-        mesh_devices: int = 0) -> int:
+        mesh_devices: int = 0, hang_grace: float = 90.0,
+        first_round_grace: float = 240.0) -> int:
     backend = select_backend(device)
     i, k = shard
     from ..parallel.multihost import plan_nonce_ranges
@@ -90,7 +121,14 @@ def run(address: str, node: str, device: str, batch: int, ttl: float,
     lo, hi = plan_nonce_ranges(k)[i]
     print(f"upow_tpu miner: backend={backend} shard={i}/{k} "
           f"nonces=[{lo}, {hi}) node={node}")
+    # first round gets extra headroom: a cold-cache pallas compile can
+    # legitimately exceed the steady-state ttl+grace budget
+    heartbeat = {"t": time.monotonic(),
+                 "limit": ttl + hang_grace + first_round_grace}
+    if backend in ("pallas", "jnp", "mesh") and not once:
+        _start_hang_watchdog(heartbeat, ttl + hang_grace)
     while True:
+        heartbeat["t"] = time.monotonic()
         try:
             info = fetch_mining_info(node)
         except (urllib.error.URLError, OSError, ValueError) as e:
@@ -102,6 +140,8 @@ def run(address: str, node: str, device: str, batch: int, ttl: float,
               f"confirming {len(pending_hashes)} transactions")
 
         def progress(tried, elapsed):
+            heartbeat["t"] = time.monotonic()
+            heartbeat["limit"] = ttl + hang_grace  # compiled: steady budget
             print(f"{tried / elapsed / 1e6:.2f} MH/s ({tried} hashes)")
 
         result = mine(job, backend, start=lo, stride_end=hi, batch=batch,
@@ -139,6 +179,44 @@ def _reap(procs, timeout: float = 5.0) -> None:
             p.wait()
 
 
+def _child_cmd(args) -> list:
+    """Base child command shared by the supervisor and the worker fan-out
+    (one home so new flags cannot silently diverge)."""
+    return [sys.executable, "-m", "upow_tpu.mine.miner", args.address,
+            "--node", args.node, "--device", args.device,
+            "--batch", str(args.batch), "--ttl", str(args.ttl)]
+
+
+def _supervise(args) -> int:
+    """Respawn loop for device-backend miners (reference miner.py:149-156):
+    restart the mining child whenever it exits — watchdog hang-exit (rc 3),
+    crash, or backend failure — with a short backoff."""
+    import os
+    import subprocess
+
+    env = dict(os.environ, UPOW_MINER_CHILD="1")
+    cmd = _child_cmd(args) + ["--shard", args.shard]
+    child = None
+    try:
+        while True:
+            child = subprocess.Popen(cmd, env=env)
+            rc = child.wait()
+            if rc == 0:
+                return 0
+            print(f"miner child exited rc={rc}; respawning in 5s",
+                  file=sys.stderr, flush=True)
+            child = None
+            time.sleep(5)
+    except KeyboardInterrupt:
+        if child is not None:
+            child.terminate()
+            try:
+                child.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                child.kill()
+        return 130
+
+
 def _run_workers(args) -> int:
     """Reference-style multi-process fan-out (miner.py:126-156): worker i
     takes contiguous shard i/N.  CPU-parity path — one process drives a
@@ -151,15 +229,18 @@ def _run_workers(args) -> int:
               "cpu, or shard across hosts with --shard/UPOW_COORDINATOR_"
               "ADDRESS", file=sys.stderr)
         return 2
+    import os
+
     procs = []
-    base = [sys.executable, "-m", "upow_tpu.mine.miner", args.address,
-            "--node", args.node, "--device", args.device,
-            "--batch", str(args.batch), "--ttl", str(args.ttl)]
+    base = _child_cmd(args)
     if args.once:
         base.append("--once")
+    # workers are leaf miners: the child marker stops each one becoming a
+    # nested supervisor (which would mask failures and orphan grandchildren)
+    env = dict(os.environ, UPOW_MINER_CHILD="1")
     for i in range(args.workers):
         procs.append(subprocess.Popen(
-            base + ["--shard", f"{i}/{args.workers}"]))
+            base + ["--shard", f"{i}/{args.workers}"], env=env))
     try:
         while True:
             codes = [p.poll() for p in procs]
@@ -202,6 +283,15 @@ def main(argv=None) -> int:
         args.node = args.node_pos
     if args.workers > 1:
         return _run_workers(args)
+    import os
+
+    if (not args.once and select_backend(args.device) in ("pallas", "jnp",
+                                                          "mesh")
+            and not os.environ.get("UPOW_MINER_CHILD")):
+        # device backends run supervised: the hang watchdog hard-exits a
+        # child stuck in a dead-tunnel dispatch, and this loop respawns it
+        # (the reference's outer watchdog, miner.py:149-156)
+        return _supervise(args)
     i, k = (int(x) for x in args.shard.split("/"))
     assert 0 <= i < k, "--shard must be i/k with 0 <= i < k"
     if (i, k) == (0, 1):
