@@ -144,3 +144,40 @@ def test_push_tx_rejects_coinbase_and_unsigned(tmp_path):
             await server.close()
 
     asyncio.run(main())
+
+
+def test_sig_checks_survive_hung_device(monkeypatch):
+    """A device dispatch that hangs (dead TPU tunnel) must not wedge
+    block verification: the call times out, the device path is poisoned,
+    and the host path produces the verdicts."""
+    import time as _time
+
+    from upow_tpu.core import curve
+    from upow_tpu.crypto import p256
+    from upow_tpu.verify import txverify
+
+    d, pub = curve.keygen(rng=808)
+    import hashlib
+
+    checks = []
+    for i in range(10):
+        m = bytes([i]) * 9
+        r, s = curve.sign(m, d)
+        if i % 3 == 2:
+            s = (s + 1) % curve.CURVE_N if hasattr(curve, "CURVE_N") else s + 1
+        digest = hashlib.sha256(m).digest()
+        checks.append((digest, hashlib.sha256(m.hex().encode()).digest(),
+                       (r, s), pub))
+
+    monkeypatch.setattr(p256, "verify_batch_prehashed",
+                        lambda *a, **k: _time.sleep(600))
+    monkeypatch.setattr(txverify, "_DEVICE_POISONED", False)
+    t0 = _time.monotonic()
+    out = txverify.run_sig_checks(checks, backend="device",
+                                  device_timeout=1.5)
+    assert _time.monotonic() - t0 < 30
+    assert txverify._DEVICE_POISONED
+    want = txverify.run_sig_checks(checks, backend="host")
+    assert out == want
+    # and auto now routes straight to host
+    assert txverify.run_sig_checks(checks, backend="auto") == want

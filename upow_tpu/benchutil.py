@@ -20,24 +20,53 @@ import time
 from typing import Optional
 
 
-def probe_platform(timeout: float = 90.0) -> Optional[str]:
-    """Platform string of jax.devices()[0]; None if init hung or failed."""
-    import threading
+def boxed_call(fn, timeout: float):
+    """Run ``fn`` on a daemon thread with a deadline.
 
-    import jax
+    Returns ("ok", result) | ("err", exception) | ("timeout", None).
+    The one home of the hang-survival idiom: a call stuck inside the
+    PJRT client can neither be interrupted nor joined — the daemon
+    thread is abandoned and the caller decides what degraded mode means.
+    """
+    import threading
 
     box: dict = {}
 
-    def probe():
+    def run():
         try:
-            box["platform"] = jax.devices()[0].platform
+            box["ok"] = fn()
         except Exception as e:
-            box["error"] = e
+            box["err"] = e
 
-    t = threading.Thread(target=probe, daemon=True)
+    t = threading.Thread(target=run, daemon=True)
     t.start()
     t.join(timeout)
-    return box.get("platform")
+    if "ok" in box:
+        return "ok", box["ok"]
+    if "err" in box:
+        return "err", box["err"]
+    return "timeout", None
+
+
+def probe_platform(timeout: float = 90.0) -> Optional[str]:
+    """Platform string of jax.devices()[0]; None if init hung or failed."""
+    import jax
+
+    status, value = boxed_call(lambda: jax.devices()[0].platform, timeout)
+    return value if status == "ok" else None
+
+
+_PROBE_CACHE: dict = {}
+
+
+def probed_platform_cached(timeout: float = 90.0) -> Optional[str]:
+    """One probe per process, shared by every jax consumer that must not
+    wedge on a dead tunnel (node signature dispatch, device UTXO index,
+    bench) — so a hung backend costs the process ONE timeout, not one
+    per subsystem."""
+    if "platform" not in _PROBE_CACHE:
+        _PROBE_CACHE["platform"] = probe_platform(timeout)
+    return _PROBE_CACHE["platform"]
 
 
 def python_loop_mhs(prefix: bytes, seconds: float = 1.0) -> float:

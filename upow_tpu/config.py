@@ -32,6 +32,8 @@ class DeviceConfig:
     sig_backend: str = "auto"       # auto | tpu | host
     search_batch: int = 1 << 24     # nonces per device dispatch
     verify_pad_block: int = 128     # lane padding for the P-256 kernel
+    verify_device_timeout: float = 240.0  # seconds before a hung device
+                                    # dispatch falls back to the host path
     mesh_devices: int = 0           # 0 = all visible devices
     utxo_index: bool = False        # device-resident UTXO membership
                                     # prefilter on block accept (worth it

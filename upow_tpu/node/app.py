@@ -67,7 +67,8 @@ class Node:
                                 device_index=self.config.device.utxo_index)
         self.manager = BlockManager(
             self.state, sig_backend=self.config.device.sig_backend,
-            verify_pad_block=self.config.device.verify_pad_block)
+            verify_pad_block=self.config.device.verify_pad_block,
+            verify_device_timeout=self.config.device.verify_device_timeout)
         self.peers = PeerBook(self.config.node)
         self.ip_filter = IpFilter(self.config.node.ip_config_file)
         from .ratelimit import RateLimiter
@@ -271,6 +272,8 @@ class Node:
             ok = await TxVerifier(
                 self.state,
                 verify_pad_block=self.config.device.verify_pad_block,
+                verify_device_timeout=(
+                    self.config.device.verify_device_timeout),
             ).verify_pending(tx, sig_backend=self.config.device.sig_backend)
         except Exception as e:
             log.info("tx verify error %s: %s", tx_hash, e)

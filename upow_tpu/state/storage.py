@@ -189,7 +189,21 @@ class ChainState:
         Maintained incrementally by the output add/remove paths; bulk
         operations (reorg rollback, full replay) rebuild from the tables
         — the index is reconstructible at any height, which is its
-        checkpoint/resume story."""
+        checkpoint/resume story.
+
+        No-op (with a warning) when the jax backend cannot initialize —
+        a dead TPU tunnel HANGS backend init, and a node must boot and
+        validate on the sqlite path rather than wedge here."""
+        from ..benchutil import probed_platform_cached
+
+        if probed_platform_cached(timeout=90.0) is None:
+            import logging
+
+            logging.getLogger("upow_tpu.state").warning(
+                "jax backend init hung/failed; device UTXO index disabled "
+                "— sqlite membership checks only")
+            self._dev_index = None
+            return
         from .device_index import DeviceUtxoIndex
 
         self._dev_index = {}

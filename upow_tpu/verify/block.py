@@ -32,7 +32,7 @@ from ..core.merkle import merkle_root
 from ..core.rewards import get_block_reward, get_inode_rewards
 from ..core.tx import CoinbaseTx, Tx, TxOutput
 from ..state.storage import ChainState, _INPUT_TABLE
-from .txverify import TxVerifier, run_sig_checks
+from .txverify import TxVerifier, run_sig_checks_async
 
 # Historical chain patches: grandfathered double-spends by height and the
 # one merkle exception (consensus DATA for mainnet compatibility;
@@ -76,10 +76,12 @@ class BlockManager:
     """Difficulty, check_block, create_block over one ChainState."""
 
     def __init__(self, state: ChainState, sig_backend: str = "auto",
-                 verify_pad_block: int = 128):
+                 verify_pad_block: int = 128,
+                 verify_device_timeout: float = 240.0):
         self.state = state
         self.sig_backend = sig_backend
         self.verify_pad_block = verify_pad_block
+        self.verify_device_timeout = verify_device_timeout
         self._difficulty_cache: Optional[Tuple[Decimal, dict]] = None
         self._inode_cache: Optional[List[dict]] = None
         self._inode_cache_time = 0.0
@@ -172,7 +174,10 @@ class BlockManager:
                 return False
 
         # per-tx rules + ONE batched signature dispatch for the whole block
-        verifier = TxVerifier(self.state, is_syncing=self.is_syncing)
+        verifier = TxVerifier(
+            self.state, is_syncing=self.is_syncing,
+            verify_pad_block=self.verify_pad_block,
+            verify_device_timeout=self.verify_device_timeout)
         all_checks: List[tuple] = []
         for tx in transactions:
             if not await verifier.rules_ok(tx, check_double_spend=False):
@@ -183,8 +188,10 @@ class BlockManager:
                 errors.append(f"transaction {tx.hash()} has been not verified")
                 return False
             all_checks.extend(checks)
-        if not all(run_sig_checks(all_checks, backend=self.sig_backend,
-                                  pad_block=self.verify_pad_block)):
+        if not all(await run_sig_checks_async(
+                all_checks, backend=self.sig_backend,
+                pad_block=self.verify_pad_block,
+                device_timeout=self.verify_device_timeout)):
             errors.append("signature verification failed")
             return False
 

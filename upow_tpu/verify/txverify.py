@@ -70,8 +70,57 @@ def _dedup_sig_checks(tx: Tx, voter: bool,
     return checks
 
 
+_DEVICE_POISONED = False  # set when the accelerator hung / kept failing
+_DEVICE_FAILURES = 0  # consecutive device-dispatch failures
+_DEVICE_FAILURE_LIMIT = 3
+
+
+def _device_usable() -> bool:
+    """True iff a device backend initialized within the probe budget.
+
+    ``jax.default_backend()`` itself HANGS (not raises) when the
+    tunneled-TPU PJRT client cannot reach the chip — observed live:
+    ``jax.devices()`` blocked >500 s.  A validating node must never
+    wedge block accept on that, so backend detection goes through the
+    process-wide thread-boxed probe (benchutil), and a hang poisons the
+    device path for the life of the process (the stuck thread cannot be
+    recovered)."""
+    global _DEVICE_POISONED
+    if _DEVICE_POISONED:
+        return False
+    from ..benchutil import probed_platform_cached
+
+    platform = probed_platform_cached(timeout=90.0)
+    if platform is None:
+        _DEVICE_POISONED = True
+        import logging
+
+        logging.getLogger("upow_tpu.verify").warning(
+            "jax backend init hung/failed; signature verification "
+            "pinned to the host path for this process")
+    return platform not in (None, "cpu")
+
+
+async def run_sig_checks_async(checks: Sequence[tuple],
+                               backend: str = "auto",
+                               pad_block: int = 128,
+                               device_timeout: float = 240.0) -> List[bool]:
+    """Executor-wrapped :func:`run_sig_checks`: the device dispatch (and
+    its hang time-box) must not block the node's event loop — the C++
+    host batch and ctypes both release the GIL, so this also overlaps
+    verification with peer I/O."""
+    import asyncio
+    import functools
+
+    return await asyncio.get_event_loop().run_in_executor(
+        None, functools.partial(run_sig_checks, checks, backend=backend,
+                                pad_block=pad_block,
+                                device_timeout=device_timeout))
+
+
 def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
-                   pad_block: int = 128) -> List[bool]:
+                   pad_block: int = 128,
+                   device_timeout: float = 240.0) -> List[bool]:
     """Verify deferred checks in one (or two) batched device calls.
 
     Pass 1 verifies against the raw-bytes digest; only failures re-try the
@@ -81,9 +130,10 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
     ``auto`` policy: the device batch only pays off on a real
     accelerator — on a CPU-only host the XLA ladder costs minutes of
     compile for throughput the OpenMP C++ batch beats anyway, so auto
-    means device iff jax's default backend is one, and the host batch
-    otherwise (small batches always stay host-side: dispatch overhead
-    dominates under ~8 signatures).
+    means device iff a device backend probes healthy (see
+    :func:`_device_usable` — the probe survives a hung TPU tunnel), and
+    the host batch otherwise (small batches always stay host-side:
+    dispatch overhead dominates under ~8 signatures).
     """
     if not checks:
         return []
@@ -91,9 +141,7 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
         if len(checks) < 8:
             backend = "host"
         else:
-            import jax
-
-            backend = "host" if jax.default_backend() == "cpu" else "device"
+            backend = "device" if _device_usable() else "host"
     if backend == "host":
         from .. import native
 
@@ -118,17 +166,58 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
 
     from ..crypto import p256
 
-    first = p256.verify_batch_prehashed(
-        [c[0] for c in checks], [c[2] for c in checks], [c[3] for c in checks],
-        pad_block=pad_block)
+    def device_batch(digests, sigs, pubs):
+        """Time-boxed device dispatch: a tunnel that dies AFTER the
+        startup probe makes the call hang, not raise.  A hang poisons
+        the device path immediately; raised exceptions are logged and
+        poison it after a few consecutive failures — either way the
+        caller re-runs on the host, and the node survives."""
+        global _DEVICE_POISONED, _DEVICE_FAILURES
+        import logging
+
+        from ..benchutil import boxed_call
+
+        status, value = boxed_call(
+            lambda: p256.verify_batch_prehashed(
+                digests, sigs, pubs, pad_block=pad_block),
+            timeout=device_timeout)  # generous: covers first-call compile
+        log = logging.getLogger("upow_tpu.verify")
+        if status == "ok":
+            _DEVICE_FAILURES = 0
+            return value
+        if status == "err":
+            _DEVICE_FAILURES += 1
+            if _DEVICE_FAILURES >= _DEVICE_FAILURE_LIMIT:
+                _DEVICE_POISONED = True
+            log.warning(
+                "device verify dispatch failed (%d consecutive%s): %s",
+                _DEVICE_FAILURES,
+                "; device poisoned" if _DEVICE_POISONED else "",
+                value, exc_info=value)
+            raise value
+        _DEVICE_POISONED = True
+        log.warning(
+            "device verify dispatch hung; falling back to host path "
+            "(device poisoned for this process)")
+        raise TimeoutError("device verify hung")
+
+    try:
+        first = device_batch(
+            [c[0] for c in checks], [c[2] for c in checks],
+            [c[3] for c in checks])
+    except Exception:
+        return run_sig_checks(checks, backend="host", pad_block=pad_block)
     out = list(map(bool, first))
     retry = [i for i, ok in enumerate(out) if not ok]
     if retry:
-        second = p256.verify_batch_prehashed(
-            [checks[i][1] for i in retry],
-            [checks[i][2] for i in retry],
-            [checks[i][3] for i in retry],
-            pad_block=pad_block)
+        try:
+            second = device_batch(
+                [checks[i][1] for i in retry],
+                [checks[i][2] for i in retry],
+                [checks[i][3] for i in retry])
+        except Exception:
+            return run_sig_checks(checks, backend="host",
+                                  pad_block=pad_block)
         for i, ok in zip(retry, second):
             out[i] = bool(ok)
     return out
@@ -157,10 +246,12 @@ class TxVerifier:
     """
 
     def __init__(self, state: ChainState, is_syncing: bool = False,
-                 verify_pad_block: int = 128):
+                 verify_pad_block: int = 128,
+                 verify_device_timeout: float = 240.0):
         self.state = state
         self.is_syncing = is_syncing
         self.verify_pad_block = verify_pad_block
+        self.verify_device_timeout = verify_device_timeout
 
     # -- address resolution ------------------------------------------------
 
@@ -423,8 +514,9 @@ class TxVerifier:
         checks = await self.collect_sig_checks(tx)
         if checks is None:
             return False
-        return all(run_sig_checks(checks, backend=sig_backend,
-                                  pad_block=self.verify_pad_block))
+        return all(await run_sig_checks_async(
+            checks, backend=sig_backend, pad_block=self.verify_pad_block,
+            device_timeout=self.verify_device_timeout))
 
     async def verify_pending(self, tx: Tx, sig_backend: str = "auto") -> bool:
         """add-pending intake check (transaction.py:481-482)."""
