@@ -181,3 +181,9 @@ def test_sig_checks_survive_hung_device(monkeypatch):
     assert out == want
     # and auto now routes straight to host
     assert txverify.run_sig_checks(checks, backend="auto") == want
+    # an explicitly configured device backend honors the poison flag too
+    # (no 240 s re-pay per block): instant, correct verdicts
+    t1 = _time.monotonic()
+    assert txverify.run_sig_checks(checks, backend="device",
+                                   device_timeout=120.0) == want
+    assert _time.monotonic() - t1 < 10

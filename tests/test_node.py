@@ -563,3 +563,52 @@ def test_miner_cli_reference_positionals(tmp_path, keys):
                                "--device", "tpu", "--once"]) == 2
 
     run_cluster(tmp_path, scenario)
+
+
+# ------------------------------------------------------- nodeless wallet ---
+
+def test_nodeless_wallet_end_to_end(tmp_path, keys):
+    """The HTTP-only wallet (reference nodeless_wallet.py): balance read,
+    send built purely from get_address_info, push via push_tx, mined,
+    and the consolidation path across multiple small outputs."""
+    from upow_tpu.wallet.nodeless import NodelessWallet
+
+    async def scenario(cluster):
+        node, client = await cluster.add_node("nw")
+        # fund the sender with two coinbases
+        await mine_via_api(client, keys["addr"])
+        await mine_via_api(client, keys["addr"])
+        w = NodelessWallet(cluster.url(0))
+
+        bal, pending = await w.get_balance(keys["addr"])
+        assert bal == Decimal("12")  # two 6-coin rewards
+
+        tx_hash = await w.send(keys["d"], keys["addr2"], Decimal("2.5"))
+        pend = await (await client.get("/get_pending_transactions")).json()
+        import hashlib as _h
+
+        assert [
+            _h.sha256(bytes.fromhex(t)).hexdigest() for t in pend["result"]
+        ] == [tx_hash]
+        await mine_via_api(client, keys["addr"])
+        bal2, _ = await w.get_balance(keys["addr2"])
+        assert bal2 == Decimal("2.5")
+
+        # recipient now has 1 output; sender has several (change + reward):
+        # consolidation merges them into one self-send
+        consolidated = await w.consolidate_outputs(keys["d"])
+        assert consolidated is not None
+        await mine_via_api(client, keys["addr"])
+        info = await w.get_address_info(keys["addr"])
+        spendable = [o for o in info["spendable_outputs"]]
+        # one merged output + the newest coinbase reward
+        assert len(spendable) == 2
+
+        # insufficient funds raises the reference's error message
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="enough funds"):
+            await w.create_transaction(keys["d2"], keys["addr"],
+                                       Decimal("1000000"))
+
+    run_cluster(tmp_path, scenario)

@@ -142,6 +142,12 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
             backend = "host"
         else:
             backend = "device" if _device_usable() else "host"
+    elif backend != "host" and _DEVICE_POISONED:
+        # an explicitly configured device backend must also honor the
+        # poison flag: re-paying device_timeout (and leaking another
+        # stuck daemon thread) on every block would stall the node 4 min
+        # per block after one hang
+        backend = "host"
     if backend == "host":
         from .. import native
 
@@ -216,8 +222,10 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
                 [checks[i][2] for i in retry],
                 [checks[i][3] for i in retry])
         except Exception:
-            return run_sig_checks(checks, backend="host",
-                                  pad_block=pad_block)
+            # pass-1 verdicts are already in hand (same math on device);
+            # only the hex-digest retries need the host
+            second = [_host_verify_digest(checks[i][1], checks[i][2],
+                                          checks[i][3]) for i in retry]
         for i, ok in zip(retry, second):
             out[i] = bool(ok)
     return out
