@@ -220,3 +220,39 @@ def test_miner_merkle_matches_reference_miner():
         assert merkle.miner_merkle_root(tx_hashes) == mod.calculate_merkle_root(tx_hashes)
     finally:
         sys.argv = argv
+
+
+def test_next_difficulty_retarget_boundaries():
+    """Retarget rule boundary constants (manager.py:83-121), asserted
+    against hand-computed literals (independent of the hashrate helpers,
+    which have their own differential tests): window passthrough,
+    pre-180k unclamped ratio, >=180k clamp at 2x, and the 6.0 floor from
+    block 590600."""
+    from upow_tpu.core.difficulty import (BLOCK_TIME, BLOCKS_COUNT,
+                                          START_DIFFICULTY,
+                                          next_difficulty)
+
+    D = Decimal
+    assert next_difficulty(None, None) == START_DIFFICULTY
+    assert next_difficulty({"id": 99, "timestamp": 0, "difficulty": 8.4},
+                           None) == START_DIFFICULTY
+    # non-multiple of 100: passthrough
+    assert next_difficulty({"id": 150, "timestamp": 0, "difficulty": 8.4},
+                           None) == D("8.4")
+
+    def retarget(block_id, diff, elapsed):
+        lb = {"id": block_id, "timestamp": 100_000 + elapsed,
+              "difficulty": diff}
+        return next_difficulty(lb, 100_000)
+
+    # perfectly-on-target window: unchanged
+    assert retarget(200, 8.0, BLOCKS_COUNT * BLOCK_TIME) == D("8")
+    # 10x-fast window pre-180k: ratio NOT clamped
+    assert retarget(179_900, 8.0, BLOCKS_COUNT * 6) == D("8.9")
+    # same window at 180k: clamped to a 2x hashrate step
+    assert retarget(180_000, 8.0, BLOCKS_COUNT * 6) == D("8.5")
+    # very slow window at 590600: floored at START_DIFFICULTY
+    assert retarget(590_600, 6.2, BLOCKS_COUNT * BLOCK_TIME * 50) \
+        == START_DIFFICULTY
+    # just before the floor activates: sub-6 difficulties legal
+    assert retarget(590_500, 6.2, BLOCKS_COUNT * BLOCK_TIME * 50) == D("4.8")

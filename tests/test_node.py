@@ -105,8 +105,11 @@ async def mine_via_api(client: TestClient, address: str) -> dict:
     """Drive the miner protocol over HTTP: get_mining_info → search →
     push_block (reference miner.py:126-156)."""
     from upow_tpu.core import clock
+    from upow_tpu.core.difficulty import BLOCK_TIME
 
-    clock.advance(1)  # satisfy strict timestamp monotonicity per block
+    # one BLOCK_TIME per block: monotonic timestamps AND a neutral
+    # retarget ratio, so arbitrarily long soaks keep difficulty ~1.0
+    clock.advance(BLOCK_TIME)
     resp = await client.get("/get_mining_info")
     info = (await resp.json())["result"]
     last_block = dict(info["last_block"])
@@ -610,5 +613,117 @@ def test_nodeless_wallet_end_to_end(tmp_path, keys):
         with _pytest.raises(ValueError, match="enough funds"):
             await w.create_transaction(keys["d2"], keys["addr"],
                                        Decimal("1000000"))
+
+    run_cluster(tmp_path, scenario)
+
+
+# ------------------------------------------------------ randomized soak ----
+
+def test_randomized_churn_soak(tmp_path, keys, monkeypatch):
+    """Randomized three-node churn: each round, a random node mines (with
+    a random wallet tx in flight half the time), occasionally a node is
+    partitioned off to mine a private fork and then healed via sync.
+    Invariants after every heal: one UTXO fingerprint across nodes, and a
+    full replay of node A's chain reproduces its live tables.
+
+    UPOW_SOAK_ROUNDS (default 6) scales the run for longer soaks.
+    """
+    import os
+    import random as _random
+
+    from upow_tpu.core import difficulty as _diff
+
+    rng = _random.Random(20260730)
+    rounds = int(os.environ.get("UPOW_SOAK_ROUNDS", "6"))
+    # pin the retarget: the soak's orphaned-fork clock ticks make the
+    # 100-block window ratio < 1, and sub-1.0 difficulty is UNMINABLE by
+    # protocol (the reference's [-0:] whole-hash quirk, manager.py:148-151,
+    # replicated and differential-tested in test_core_consensus).  The
+    # retarget rule itself has dedicated boundary tests.
+    monkeypatch.setattr(_diff, "next_difficulty",
+                        lambda *_a, **_k: Decimal("1.0"))
+
+    async def scenario(cluster):
+        nodes, clients = [], []
+        for name in ("a", "b", "c"):
+            n, c = await cluster.add_node(name)
+            # fork detection only runs when the chain is LONGER than the
+            # reorg window (reference main.py:167) — keep it smaller than
+            # the funding prefix below
+            n.config.node.sync_reorg_window = 4
+            n.rate_limiter.enabled = False  # soak load: not a client test
+            nodes.append(n)
+            clients.append(c)
+        for i, n in enumerate(nodes):
+            for j in range(3):
+                if j != i:
+                    n.peers.add(cluster.url(j))
+
+        async def converge(idx_set, tries=120):
+            for _ in range(tries):
+                ids = [await nodes[i].state.get_next_block_id()
+                       for i in idx_set]
+                if len(set(ids)) == 1:
+                    return ids[0]
+                await asyncio.sleep(0.1)
+            raise AssertionError(
+                f"no convergence: {[(i, await nodes[i].state.get_next_block_id()) for i in idx_set]}")
+
+        # funding prefix, longer than the reorg window
+        for _ in range(6):
+            assert (await mine_via_api(clients[0], keys["addr"]))["ok"]
+        await converge({0, 1, 2})
+
+        for rnd in range(rounds):
+            miner_i = rng.randrange(3)
+            if rng.random() < 0.5:
+                # random spend into the mempool of the mining node
+                builder = WalletBuilder(nodes[miner_i].state)
+                try:
+                    tx = await builder.create_transaction(
+                        keys["d"], keys["addr2"],
+                        Decimal(rng.randrange(1, 40)) / 10)
+                    await nodes[miner_i].state.add_pending_transaction(tx)
+                except ValueError:
+                    pass  # no spendable outputs on this node's view yet
+            assert (await mine_via_api(clients[miner_i], keys["addr"]))["ok"]
+            await converge({0, 1, 2})
+
+            if rng.random() < 0.4:
+                # partition a random victim; it mines a private fork
+                victim = rng.randrange(3)
+                others = [i for i in range(3) if i != victim]
+                for i in others:
+                    nodes[i].peers.remove(cluster.url(victim))
+                for i in others:
+                    nodes[victim].peers.remove(cluster.url(i))
+                for _ in range(rng.randrange(1, 3)):
+                    # NB the genesis-key emission gate (manager.py:679-689):
+                    # with no registered inodes only the genesis address may
+                    # mine, so the fork differs by timestamp, not miner
+                    assert (await mine_via_api(clients[victim],
+                                               keys["addr"]))["ok"]
+                # majority extends further so the victim must reorg
+                for _ in range(3):
+                    assert (await mine_via_api(clients[others[0]],
+                                               keys["addr"]))["ok"]
+                await converge(set(others))
+                # heal
+                for i in others:
+                    nodes[i].peers.add(cluster.url(victim))
+                    nodes[victim].peers.add(cluster.url(i))
+                res = await (await clients[victim].get(
+                    "/sync_blockchain",
+                    params={"node_url": cluster.url(others[0])})).json()
+                assert res["ok"], res
+                await converge({0, 1, 2})
+
+            fps = {await n.state.get_unspent_outputs_hash() for n in nodes}
+            assert len(fps) == 1, f"fingerprint divergence in round {rnd}"
+
+        # replay oracle on node A
+        live = await nodes[0].state.get_unspent_outputs_hash()
+        await nodes[0].state.rebuild_utxos()
+        assert await nodes[0].state.get_unspent_outputs_hash() == live
 
     run_cluster(tmp_path, scenario)
