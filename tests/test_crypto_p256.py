@@ -449,7 +449,9 @@ def test_device_prep_input_sanitation_fast():
     finally:
         p256._prep_and_verify_jnp = orig
 
-    lane = lambda arr, j: fp.limbs_to_int(arr[:, j])
+    def lane(arr, j):  # (8, N) packed uint32 words -> int
+        return int.from_bytes(np.asarray(arr[:, j]).astype("<u4").tobytes(),
+                              "little")
     z512 = int.from_bytes(digests[0], "big")
     assert lane(captured["z"], 0) == z512 % CURVE_N
     assert lane(captured["z"], 1) == int.from_bytes(digests[1], "big")
@@ -457,3 +459,18 @@ def test_device_prep_input_sanitation_fast():
     assert lane(captured["qy"], 0) == (-5) % CURVE_P
     assert lane(captured["r"], 1) == 0 and lane(captured["s"], 1) == 0
     assert list(captured["range_ok"][:2]) == [True, False]
+
+
+def test_packed_word_unpack_matches_limbs():
+    """(8, N) uint32 wire format -> limbs must equal the host packer for
+    the full 256-bit range (incl. the top limbs that spill past word 8)."""
+    import random as _random
+
+    r = _random.Random(4)
+    xs = [r.randrange(1 << 256) for _ in range(40)] + [0, 1, (1 << 256) - 1]
+    import jax.numpy as jnp
+
+    w = jnp.asarray(p256._pack_words(xs, 3))  # with padding lanes
+    got = np.asarray(p256._words_to_limbs(w))
+    want = np.pad(fp.ints_to_limbs(xs), ((0, 0), (0, 3)))
+    assert np.array_equal(got, want)

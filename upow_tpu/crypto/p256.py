@@ -290,6 +290,35 @@ def _mod_n_inv_mont(s_m: FE) -> FE:
     return fp.wrap(out, _SCALAR_BOUND)
 
 
+def _words_to_limbs(w) -> jnp.ndarray:
+    """(8, N) uint32 little-endian words -> (21, N) int32 13-bit limbs.
+
+    The host ships 256-bit scalars as 32 raw bytes instead of 84 bytes
+    of pre-split limbs (2.6x less host->device transfer on the tunneled
+    chip); the split is ~4 static shift/mask ops per limb here."""
+    lb = fp.LIMB_BITS
+    rows = []
+    for j in range(fp.NUM_LIMBS):
+        lo_bit = lb * j
+        a, r = divmod(lo_bit, 32)
+        if a >= 8:
+            rows.append(jnp.zeros_like(w[0], dtype=jnp.int32))
+            continue
+        v = w[a] >> jnp.uint32(r)
+        if r + lb > 32 and a + 1 < 8:
+            v = v | (w[a + 1] << jnp.uint32(32 - r))
+        rows.append((v & jnp.uint32(fp.LIMB_MASK)).astype(jnp.int32))
+    return jnp.stack(rows, axis=0)
+
+
+def _pack_words(xs, pad: int) -> np.ndarray:
+    """Host ints (< 2^256) -> (8, N+pad) uint32 little-endian words."""
+    n = len(xs)
+    raw = b"".join(x.to_bytes(32, "little") for x in xs)
+    w = np.frombuffer(raw, dtype="<u4").reshape(n, 8).T
+    return np.pad(w, ((0, 0), (0, pad)), constant_values=0)
+
+
 def _digits_from_limbs(limbs) -> jnp.ndarray:
     """(21, N) canonical 13-bit limbs -> (64, N) w=4 digits, MSB first.
 
@@ -307,16 +336,18 @@ def _digits_from_limbs(limbs) -> jnp.ndarray:
 
 @jax.jit
 def _scalar_prep(z, r, s, qx, qy, range_ok, rn_ok):
-    """Raw little-endian limbs -> ladder inputs, all on device.
+    """Packed 256-bit scalars -> ladder inputs, all on device.
 
-    z/r/s/qx/qy: (21, N) int32 limbs of the digest int, signature pair and
-    affine pubkey (values < 2^256, unreduced).  range_ok: host-checked
-    0 < r,s < n and qx,qy < p, (qx,qy) != (0,0).  rn_ok: r + n < p.
+    z/r/s/qx/qy: (8, N) uint32 little-endian words of the digest int,
+    signature pair and affine pubkey (values < 2^256, unreduced; see
+    :func:`_pack_words`).  range_ok: host-checked 0 < r,s < n and
+    (qx,qy) != (0,0).  rn_ok: r + n < p.
 
     Returns (d1, d2, qx_m, qy_m, r_mp, rn_mp, flags) matching the ladder
     kernel's operands: canonical Montgomery limbs + (2, N) int32 flags.
     """
     fs, ns = _FS, _NS
+    z, r, s, qx, qy = (_words_to_limbs(x) for x in (z, r, s, qx, qy))
     n_lanes = z.shape[1]
     raw = 1 << 256  # bound of any 256-bit input
 
@@ -814,10 +845,9 @@ def verify_batch_prehashed(
         pad = padded - n
 
         def lanes(xs):
-            return jnp.asarray(np.pad(
-                fp.ints_to_limbs(xs), ((0, 0), (0, pad)), constant_values=0))
+            return jnp.asarray(_pack_words(xs, pad))
 
-        def sane(x):  # out-of-[0, 2^256) scalars never reach the limb packer
+        def sane(x):  # out-of-[0, 2^256) scalars never reach the word packer
             return x if 0 <= x < (1 << 256) else 0
 
         def coord(x):
