@@ -488,7 +488,9 @@ def test_miner_cli_against_node(tmp_path, keys):
         # genesis block (free PoW), then fund a pending tx
         clock.advance(1)
         assert await loop.run_in_executor(None, mine_once) == 0
-        assert await node.state.get_next_block_id() == 2
+        # at difficulty 1.0 the losing worker may legally land a second
+        # block before the first-finder reap: >= 2, not == 2
+        assert await node.state.get_next_block_id() >= 2
 
         builder = WalletBuilder(node.state)
         tx = await builder.create_transaction(keys["d"], keys["addr2"], "1.5")
@@ -559,7 +561,9 @@ def test_miner_cli_reference_positionals(tmp_path, keys):
                                    "--batch", str(1 << 14), "--once"])
 
         assert await loop.run_in_executor(None, mine_once) == 0
-        assert await node.state.get_next_block_id() == 2
+        # at difficulty 1.0 the losing worker may legally land a second
+        # block before the first-finder reap: >= 2, not == 2
+        assert await node.state.get_next_block_id() >= 2
         # tpu fan-out is refused rather than letting N processes fight
         # over the single-client chip
         assert miner_cli.main([keys["addr"], "2", node_url,
