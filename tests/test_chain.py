@@ -436,3 +436,40 @@ def test_big_block_batched_accept(keys):
         state.close()
 
     run(scenario())
+
+
+def test_mempool_fee_rate_ordering(keys):
+    """Mempool slices order by fee/size descending with a total-size cap
+    (reference database.py:171-186 ORDER BY fees/LENGTH(tx_hex) DESC)."""
+
+    async def scenario():
+        state = ChainState()
+        manager = BlockManager(state, sig_backend="host")
+        a1, d1 = keys["a1"], keys["d1"]
+        for i in range(4):
+            await mine_and_accept(manager, state, a1, ts_offset=i - 9)
+
+        # three 1-input sends with deliberate fees 0 / 0.2 / 0.5
+        from upow_tpu.core.codecs import string_to_point
+
+        spendable = await state.get_spendable_outputs(a1)
+        fees = [0, 20_000_000, 50_000_000]
+        txs = []
+        for inp, fee in zip(spendable, fees):
+            tx = Tx([inp], [TxOutput(keys["a2"], inp.amount - fee)])
+            pub = string_to_point(a1)
+            tx.sign([d1], lambda _i: pub)
+            await state.add_pending_transaction(tx)
+            txs.append(tx)
+
+        ordered = await state.get_pending_transactions_limit(hex_only=True)
+        # same length txs: fee-rate order == fee order, highest first
+        assert ordered == [txs[2].hex(), txs[1].hex(), txs[0].hex()]
+        # the size cap truncates whole transactions, best-rate first
+        capped = await state.get_pending_transactions_limit(
+            limit_hex_chars=len(txs[2].hex()) + len(txs[1].hex()),
+            hex_only=True)
+        assert capped == [txs[2].hex(), txs[1].hex()]
+        state.close()
+
+    run(scenario())

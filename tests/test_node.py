@@ -731,3 +731,35 @@ def test_randomized_churn_soak(tmp_path, keys, monkeypatch):
         assert await nodes[0].state.get_unspent_outputs_hash() == live
 
     run_cluster(tmp_path, scenario)
+
+
+def test_mining_info_ten_tx_template(tmp_path, keys):
+    """get_mining_info hands miners at most 10 full txs but ALL pending
+    hashes, with merkle_root over those first 10 (reference
+    main.py:675-695 — the '10-tx template' quirk)."""
+
+    async def scenario(cluster):
+        from upow_tpu.core.merkle import merkle_root as _mr
+        from upow_tpu.core.tx import tx_from_hex as _fromhex
+
+        node, client = await cluster.add_node("a")
+        for _ in range(12):
+            await mine_via_api(client, keys["addr"])
+        builder = WalletBuilder(node.state)
+        hashes = set()
+        for i in range(12):
+            tx = await builder.create_transaction(
+                keys["d"], keys["addr2"], Decimal(i + 1) / 10)
+            res = await (await client.get(
+                "/push_tx", params={"tx_hex": tx.hex()})).json()
+            assert res["ok"], res
+            hashes.add(tx.hash())
+        info = (await (await client.get("/get_mining_info")).json())["result"]
+        assert len(info["pending_transactions"]) == 10
+        assert set(info["pending_transactions_hashes"]) == hashes
+        assert len(info["pending_transactions_hashes"]) == 12
+        first_ten = [_fromhex(t, check_signatures=False)
+                     for t in info["pending_transactions"]]
+        assert info["merkle_root"] == _mr(first_ten)
+
+    run_cluster(tmp_path, scenario)
