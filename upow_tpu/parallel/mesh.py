@@ -56,7 +56,10 @@ def shard_bounds(total_lo: int, total_hi: int, index: int, count: int) -> Tuple[
 )
 def _pow_search_mesh(midstate, tail_words, nonce_base, batch_per_device: int,
                      nonce_spec, spec: TargetSpec, mesh: Mesh):
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
 
     def per_device(mid, tail, base):
         idx = jax.lax.axis_index("dp")
