@@ -77,7 +77,7 @@ def _pow_search_mesh(midstate, tail_words, nonce_base, batch_per_device: int,
         mesh=mesh,
         in_specs=(P(), P(), P()),
         out_specs=P(),
-        check_rep=False,
+        check_vma=False,  # jax 0.8 name (was check_rep)
     )(midstate, tail_words, nonce_base.reshape(1))[0]
 
 
