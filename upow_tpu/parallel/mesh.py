@@ -57,9 +57,11 @@ def shard_bounds(total_lo: int, total_hi: int, index: int, count: int) -> Tuple[
 def _pow_search_mesh(midstate, tail_words, nonce_base, batch_per_device: int,
                      nonce_spec, spec: TargetSpec, mesh: Mesh):
     try:
-        from jax import shard_map  # jax >= 0.8
-    except ImportError:  # pragma: no cover - older jax
+        from jax import shard_map  # jax >= 0.8 (check_vma kwarg)
+        check_kw = {"check_vma": False}
+    except ImportError:  # pragma: no cover - older jax (check_rep kwarg)
         from jax.experimental.shard_map import shard_map
+        check_kw = {"check_rep": False}
 
     def per_device(mid, tail, base):
         idx = jax.lax.axis_index("dp")
@@ -77,7 +79,7 @@ def _pow_search_mesh(midstate, tail_words, nonce_base, batch_per_device: int,
         mesh=mesh,
         in_specs=(P(), P(), P()),
         out_specs=P(),
-        check_vma=False,  # jax 0.8 name (was check_rep)
+        **check_kw,
     )(midstate, tail_words, nonce_base.reshape(1))[0]
 
 
