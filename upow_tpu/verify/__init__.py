@@ -1,4 +1,5 @@
 """Block + transaction validation with TPU-batched signature verify."""
 
 from .block import BlockManager, DOUBLE_SPEND_WHITELIST, MERKLE_EXCEPTION
-from .txverify import TxVerifier, run_sig_checks
+from .txverify import (TxVerifier, run_sig_checks,
+                       run_sig_checks_async)
