@@ -136,22 +136,29 @@ def config3_batch_verify(seconds: float):
 
     captured = {}
     orig_pallas, orig_jnp = P._prep_and_verify_pallas, P._prep_and_verify_jnp
+    orig_jac = P._prep_and_verify_pallas_jac
 
     def cap_pallas(*a, **kw):
         captured["call"] = lambda: orig_pallas(*a, **kw)
         return orig_pallas(*a, **kw)
+
+    def cap_jac(*a, **kw):
+        captured["call"] = lambda: orig_jac(*a, **kw)
+        return orig_jac(*a, **kw)
 
     def cap_jnp(*a, **kw):
         captured["call"] = lambda: orig_jnp(*a, **kw)
         return orig_jnp(*a, **kw)
 
     P._prep_and_verify_pallas, P._prep_and_verify_jnp = cap_pallas, cap_jnp
+    P._prep_and_verify_pallas_jac = cap_jac
     try:
         p256.verify_batch_prehashed(digests, sigs, pubs, pad_block=8192,
                                     scalar_prep="device")
     finally:
         P._prep_and_verify_pallas, P._prep_and_verify_jnp = (orig_pallas,
                                                              orig_jnp)
+        P._prep_and_verify_pallas_jac = orig_jac
     if "call" in captured:
         jax.block_until_ready(captured["call"]())
         t0 = time.perf_counter()

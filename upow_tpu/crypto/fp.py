@@ -253,6 +253,23 @@ class FL:
             f"fp bound overflow: {self.bound.bit_length()} bits")
 
 
+def _xp(*arrs):
+    """numpy when every input is a host numpy array (eager differential
+    tests run the limb-list programs at C speed), jax otherwise (tracers,
+    device arrays, Pallas ref reads).  Most limb ops are dunder-dispatched
+    and need no shim — this covers the explicit ``where``/``zeros`` calls."""
+    return np if all(isinstance(a, np.ndarray) for a in arrs) else jnp
+
+
+def l_full(x: int, like, bound: int) -> FL:
+    """Broadcast a host int against a sample limb array, matching its
+    array namespace (see :func:`_xp`)."""
+    xp = _xp(like)
+    limbs = int_to_limbs(x)
+    return FL(tuple(xp.full(like.shape, int(l), dtype=xp.int32)
+                    for l in limbs), bound)
+
+
 def l_wrap(limbs, bound: int) -> FL:
     return FL(tuple(limbs), bound)
 
@@ -298,8 +315,8 @@ def _l_mont_reduce(t: list, bound_product: int, fs: FieldSpec) -> FL:
     double-width accumulator, run the 21 reduction rounds, sweep the top
     half.  ``t`` rows may be None (rows no product reached)."""
     L = NUM_LIMBS
-    t = [jnp.zeros_like(next(x for x in t if x is not None)) if r is None
-         else r for r in t]
+    sample = next(x for x in t if x is not None)
+    t = [_xp(sample).zeros_like(sample) if r is None else r for r in t]
     t = _l_sweep(t, 3)
     for i in range(L):
         m = (t[i] * fs.pinv) & LIMB_MASK
@@ -377,7 +394,15 @@ def _l_cond_sub(t: list, m: int) -> list:
         limbs.append(v & LIMB_MASK)
         c = v >> LIMB_BITS
     ge = c == 0
-    return [jnp.where(ge, d, orig) for d, orig in zip(limbs, t)]
+    xp = _xp(*t)
+    return [xp.where(ge, d, orig) for d, orig in zip(limbs, t)]
+
+
+def l_select(cond, a: FL, b: FL) -> FL:
+    """cond ? a : b per lane; ``cond`` is a bool array of the limb shape."""
+    xp = _xp(*a.limbs, *b.limbs)
+    return FL(tuple(xp.where(cond, x, y) for x, y in zip(a.limbs, b.limbs)),
+              max(a.bound, b.bound))
 
 
 def l_is_zero_mod_p(a: FL, fs: FieldSpec):

@@ -726,7 +726,419 @@ def _verify_device_pallas_stacked(d1, d2, qx, qy, r_m, rn_m, flags,
     return out[0] != 0
 
 
+# --- Jacobian ladder (the fast production kernel) --------------------------
+# The RCB16 complete-addition ladder above is branch-free and safe for any
+# input, but pays ~14 Montgomery products per add and 14 per doubling-as-
+# addition.  Jacobian coordinates cut the per-round product count ~1.5x:
+# doubling is 3M+5S (dbl-2001-b, a = -3), the G-add is a mixed affine add
+# (madd-2007-bl, 7M+4S) and the Q-add a general add (add-2007-bl, 11M+5S).
+#
+# Jacobian formulas are NOT complete — they break when an operand is the
+# identity or when P1 = ±P2.  Consensus safety is preserved structurally:
+#
+# * identity operands never reach the formulas: a zero window digit keeps
+#   the accumulator (digit==0 mask select), and an all-zero-so-far scalar
+#   prefix ("started" flag) replaces the result with the picked point;
+#   the identity encoding (R, R, 0) is an exact fixed point of the
+#   doubling program, so untouched lanes stay canonical through the 4
+#   doublings per round;
+# * the remaining exceptional case — H ≡ 0 with both operands real, i.e.
+#   the accumulator colliding with ±(table pick) — sets a per-lane
+#   EXCEPTION FLAG, and flagged lanes are re-verified on the host oracle
+#   (:func:`_host_verify_prehashed`).  For honest signatures a collision
+#   has probability ~2⁻²⁵⁰; a crafted signature can at worst force its
+#   own lane onto the host path (one ~ms verify), never flip a verdict.
+#
+# Both sub-cases of H ≡ 0 are flagged (P1 = P2, which needs a doubling,
+# and P1 = −P2, which yields the identity), so the ladder never has to
+# distinguish them on device.
+
+_JB = 64 * CURVE_P  # Jacobian ladder loop-invariant coordinate bound
+
+
+def _jac_clamp(P):
+    for c in P:
+        assert c.bound <= _JB, c.bound.bit_length()
+    return tuple(fp.l_wrap(c.limbs, _JB) for c in P)
+
+
+def _jac_dbl(P, fs=_FS):
+    """dbl-2001-b (a = -3): 3M + 5S.  Identity-safe: (X, Y, 0) maps to
+    Z3 = (Y+0)² − Y² − 0 = 0, and the (R, R, 0) encoding is an exact
+    fixed point (alpha = 3R, X3 = 9R − 8R = R, Y3 = 3R·3R − 8R = R)."""
+    X, Y, Z = P
+    delta = fp.l_mont_sqr(Z, fs)
+    gamma = fp.l_mont_sqr(Y, fs)
+    beta = fp.l_mont_mul(X, gamma, fs)
+    alpha = fp.l_mont_mul(fp.l_sub(X, delta, fs), fp.l_add(X, delta), fs)
+    alpha = fp.l_add(fp.l_add(alpha, alpha), alpha)
+    beta2 = fp.l_add(beta, beta)
+    beta4 = fp.l_add(beta2, beta2)
+    beta8 = fp.l_add(beta4, beta4)
+    X3 = fp.l_sub(fp.l_mont_sqr(alpha, fs), beta8, fs)
+    g2 = fp.l_mont_sqr(gamma, fs)
+    g4 = fp.l_add(g2, g2)
+    g8 = fp.l_add(g4, g4)
+    Y3 = fp.l_sub(
+        fp.l_mont_mul(alpha, fp.l_sub(beta4, X3, fs), fs),
+        fp.l_add(g8, g8), fs)
+    Z3 = fp.l_sub(fp.l_sub(fp.l_mont_sqr(fp.l_add(Y, Z), fs), gamma, fs),
+                  delta, fs)
+    return X3, Y3, Z3
+
+
+def _jac_madd(P1, x2, y2, fs=_FS):
+    """madd-2007-bl (P2 affine, Z2 = 1): 7M + 4S.  Returns (P3, H); the
+    caller must select away P1-identity / P2-identity lanes and flag
+    H ≡ 0 lanes (P1 = ±P2)."""
+    X1, Y1, Z1 = P1
+    z1z1 = fp.l_mont_sqr(Z1, fs)
+    u2 = fp.l_mont_mul(x2, z1z1, fs)
+    s2 = fp.l_mont_mul(y2, fp.l_mont_mul(Z1, z1z1, fs), fs)
+    H = fp.l_sub(u2, X1, fs)
+    hh = fp.l_mont_sqr(H, fs)
+    i2 = fp.l_add(hh, hh)
+    i4 = fp.l_add(i2, i2)
+    j = fp.l_mont_mul(H, i4, fs)
+    rr = fp.l_sub(s2, Y1, fs)
+    rr = fp.l_add(rr, rr)
+    v = fp.l_mont_mul(X1, i4, fs)
+    X3 = fp.l_sub(fp.l_sub(fp.l_mont_sqr(rr, fs), j, fs),
+                  fp.l_add(v, v), fs)
+    y1j = fp.l_mont_mul(Y1, j, fs)
+    Y3 = fp.l_sub(fp.l_mont_mul(rr, fp.l_sub(v, X3, fs), fs),
+                  fp.l_add(y1j, y1j), fs)
+    Z3 = fp.l_sub(fp.l_sub(fp.l_mont_sqr(fp.l_add(Z1, H), fs), z1z1, fs),
+                  hh, fs)
+    return (X3, Y3, Z3), H
+
+
+def _jac_add(P1, P2, fs=_FS):
+    """add-2007-bl (both Jacobian): 11M + 5S.  Returns (P3, H); same
+    caller obligations as :func:`_jac_madd`."""
+    X1, Y1, Z1 = P1
+    X2, Y2, Z2 = P2
+    z1z1 = fp.l_mont_sqr(Z1, fs)
+    z2z2 = fp.l_mont_sqr(Z2, fs)
+    u1 = fp.l_mont_mul(X1, z2z2, fs)
+    u2 = fp.l_mont_mul(X2, z1z1, fs)
+    s1 = fp.l_mont_mul(Y1, fp.l_mont_mul(Z2, z2z2, fs), fs)
+    s2 = fp.l_mont_mul(Y2, fp.l_mont_mul(Z1, z1z1, fs), fs)
+    H = fp.l_sub(u2, u1, fs)
+    h2 = fp.l_add(H, H)
+    i = fp.l_mont_sqr(h2, fs)
+    j = fp.l_mont_mul(H, i, fs)
+    rr = fp.l_sub(s2, s1, fs)
+    rr = fp.l_add(rr, rr)
+    v = fp.l_mont_mul(u1, i, fs)
+    X3 = fp.l_sub(fp.l_sub(fp.l_mont_sqr(rr, fs), j, fs),
+                  fp.l_add(v, v), fs)
+    s1j = fp.l_mont_mul(s1, j, fs)
+    Y3 = fp.l_sub(fp.l_mont_mul(rr, fp.l_sub(v, X3, fs), fs),
+                  fp.l_add(s1j, s1j), fs)
+    Z3 = fp.l_mont_mul(
+        fp.l_sub(fp.l_sub(fp.l_mont_sqr(fp.l_add(Z1, Z2), fs), z1z1, fs),
+                 z2z2, fs), H, fs)
+    return (X3, Y3, Z3), H
+
+
+def _g_affine_table() -> np.ndarray:
+    """(2, 16, 21) int32 — affine Montgomery (x, y) of [k]G, k = 1..15.
+
+    Entry 0 is a placeholder: zero digits select the accumulator before
+    the pick is ever used."""
+    from ..core import curve as host_curve
+
+    rows = np.zeros((2, 16, fp.NUM_LIMBS), dtype=np.int32)
+    for k in range(1, 16):
+        x, y = host_curve.point_mul(k, (CURVE_GX, CURVE_GY))
+        rows[0, k] = fp.int_to_limbs(fp.to_mont(x, _FS))
+        rows[1, k] = fp.int_to_limbs(fp.to_mont(y, _FS))
+    return rows
+
+
+_G_TABLE_AFF = _g_affine_table()
+
+
+def _jac_identity(like):
+    """The (R, R, 0) identity encoding, matching ``like``'s namespace."""
+    return (fp.l_full(_ONE_M, like, CURVE_P),
+            fp.l_full(_ONE_M, like, CURVE_P),
+            fp.l_full(0, like, CURVE_P))
+
+
+def _jac_lift_affine(x2, y2):
+    return (fp.l_wrap(x2.limbs, _JB), fp.l_wrap(y2.limbs, _JB),
+            fp.l_full(_ONE_M, x2.limbs[0], _JB))
+
+
+def _jac_qtable(qx, qy, fs=_FS):
+    """Entries [1..15] = [k]Q as Jacobian FL points (bound <= _JB).
+
+    Exception-free for on-curve Q: [k]Q = ±Q would need (k∓1)Q = identity
+    with k−1 < 15 ≪ n (prime group order).  Off-curve garbage (already
+    doomed by the `valid` flag) may produce garbage entries — harmless,
+    the verdict is masked and any spurious exception flag just routes the
+    lane to the host oracle, which rejects it."""
+    e1 = _jac_clamp((fp.l_wrap(qx.limbs, CURVE_P),
+                     fp.l_wrap(qy.limbs, CURVE_P),
+                     fp.l_full(_ONE_M, qx.limbs[0], CURVE_P)))
+    entries = [e1, _jac_clamp(_jac_dbl(e1, fs))]
+    for _ in range(3, 16):
+        nxt, _h = _jac_madd(entries[-1], qx, qy, fs)
+        entries.append(_jac_clamp(nxt))
+    return entries
+
+
+def _jac_round(acc, started, exc, dg1, dg2, g_pick_fn, q_pick_fn, fs=_FS):
+    """One w=4 digit round: 4 doublings, G mixed add, Q general add —
+    with the structural identity selects and exception flagging described
+    in the section comment.  ``started``/``exc`` are int32 masks of the
+    limb shape; ``g_pick_fn(dg) -> (x2, y2)`` affine FLs, ``q_pick_fn(dg)
+    -> Jacobian FL point``.  Returns (acc, started, exc)."""
+    for _ in range(_WINDOW):
+        acc = _jac_clamp(_jac_dbl(acc, fs))
+
+    gx, gy = g_pick_fn(dg1)
+    res, H = _jac_madd(acc, gx, gy, fs)
+    acc, started, exc = _jac_apply_add(
+        acc, res, H, _jac_lift_affine(gx, gy), dg1, started, exc, fs)
+
+    q_pick = q_pick_fn(dg2)
+    res, H = _jac_add(acc, q_pick, fs)
+    acc, started, exc = _jac_apply_add(
+        acc, res, H, q_pick, dg2, started, exc, fs)
+    return acc, started, exc
+
+
+def _jac_apply_add(acc, res, H, pick_point, dg, started, exc, fs=_FS):
+    """The single-sourced post-add masking invariant for both add sites:
+
+    * digit == 0 (identity pick)            -> keep the accumulator;
+    * accumulator still identity, real pick -> take the picked point;
+    * H ≡ 0 with both operands real         -> flag the lane (P1 = ±P2,
+      the formula output is unusable; host oracle decides);
+    * otherwise                             -> the formula result.
+
+    ``started`` flips once any nonzero digit lands."""
+    pick_id = (dg == 0)
+    acc_inf = started == 0
+    h0 = fp.l_is_zero_mod_p(H, fs)
+    exc = exc | (h0 & ~pick_id & ~acc_inf).astype(np.int32)
+    out = []
+    for c_res, c_acc, c_pick in zip(res, acc, pick_point):
+        c = fp.l_select(pick_id, c_acc, fp.l_wrap(c_res.limbs, _JB))
+        c = fp.l_select(acc_inf & ~pick_id, fp.l_wrap(c_pick.limbs, _JB), c)
+        out.append(c)
+    return (_jac_clamp(tuple(out)), started | (~pick_id).astype(np.int32),
+            exc)
+
+
+def _jac_final(acc, started, r_m, rn_m, rn_ok, valid, fs=_FS):
+    """Jacobian accept check: x = X/Z², so accept ⇔ X ≡ r·Z² or
+    (r + n < p and X ≡ (r+n)·Z²) (mod p), R not the identity."""
+    X, _Y, Z = acc
+    z2 = fp.l_mont_sqr(Z, fs)
+    rz = fp.l_mont_mul(fp.l_wrap(r_m.limbs, CURVE_P), z2, fs)
+    rnz = fp.l_mont_mul(fp.l_wrap(rn_m.limbs, CURVE_P), z2, fs)
+    at_inf = fp.l_is_zero_mod_p(Z, fs) | (started == 0)
+    ok = fp.l_is_zero_mod_p(fp.l_sub(X, rz, fs), fs) | (
+        rn_ok & fp.l_is_zero_mod_p(fp.l_sub(X, rnz, fs), fs))
+    return ok & ~at_inf & valid
+
+
+def _jac_verify_eager(d1, d2, qx, qy, r_m, rn_m, rn_ok, valid,
+                      n_rounds: int = _DIGITS):
+    """Host twin of the Pallas Jacobian kernel, same round logic via the
+    shared helpers — runs on plain numpy (no jit, no device) so tests can
+    drive short crafted ladders cheaply.  d1/d2: (n_rounds, N) int32
+    digits; qx..rn_m: (21, N) canonical Montgomery limb numpy arrays;
+    rn_ok/valid: (N,) bool.  Returns (ok, exc) bool arrays."""
+    def to_fl(a, bound):
+        return fp.l_wrap([np.asarray(a[i]) for i in range(fp.NUM_LIMBS)],
+                         bound)
+
+    qx_f, qy_f = to_fl(qx, CURVE_P), to_fl(qy, CURVE_P)
+    n = d1.shape[1]
+    qtab = _jac_qtable(qx_f, qy_f)
+
+    def g_pick_fn(dg):
+        out = []
+        for c in range(2):
+            limbs = []
+            for l in range(fp.NUM_LIMBS):
+                acc = np.zeros((n,), np.int32)
+                for k in range(1, 16):
+                    g = int(_G_TABLE_AFF[c, k, l])
+                    if g:
+                        acc = acc + np.where(dg == k, g, 0)
+                limbs.append(acc)
+            out.append(fp.l_wrap(limbs, CURVE_P))
+        return tuple(out)
+
+    def q_pick_fn(dg):
+        out = []
+        for c in range(3):
+            limbs = []
+            for l in range(fp.NUM_LIMBS):
+                acc = np.zeros((n,), np.int32)
+                for k in range(1, 16):
+                    acc = acc + np.where(dg == k, qtab[k - 1][c].limbs[l], 0)
+                limbs.append(acc)
+            out.append(fp.l_wrap(limbs, _JB))
+        return tuple(out)
+
+    d1, d2 = np.asarray(d1), np.asarray(d2)
+    acc = _jac_identity(np.zeros((n,), np.int32))
+    started = np.zeros((n,), np.int32)
+    exc = np.zeros((n,), np.int32)
+    for k in range(n_rounds):
+        acc, started, exc = _jac_round(acc, started, exc, d1[k], d2[k],
+                                       g_pick_fn, q_pick_fn)
+    ok = _jac_final(acc, started, to_fl(r_m, CURVE_P), to_fl(rn_m, CURVE_P),
+                    rn_ok, valid)
+    return np.asarray(ok), np.asarray(exc != 0)
+
+
+def _ladder_kernel_jac(d1_ref, d2_ref, qx_ref, qy_ref, rm_ref, rnm_ref,
+                       flags_ref, out_ref, qtab_ref):
+    """Pallas limb-list Jacobian ladder.  Same structure as
+    :func:`_ladder_kernel_list` but ~1.5x fewer Montgomery products per
+    round; emits bit0 = verdict, bit1 = exception flag per lane."""
+    fs = _FS
+    S = qx_ref.shape[1]
+    shape = (S, 128)
+
+    def read_fl(ref, bound):
+        return fp.l_wrap([ref[i] for i in range(fp.NUM_LIMBS)], bound)
+
+    qx_f, qy_f = read_fl(qx_ref, CURVE_P), read_fl(qy_ref, CURVE_P)
+
+    # --- Q table (entries 1..15) into VMEM scratch -----------------------
+    entries = _jac_qtable(qx_f, qy_f, fs)
+    for k, e in enumerate(entries):
+        for c in range(3):
+            for l in range(fp.NUM_LIMBS):
+                qtab_ref[k, c, l] = e[c].limbs[l]
+
+    def g_pick_fn(dg):
+        masks = [(dg == k).astype(jnp.int32) for k in range(16)]
+        out = []
+        for c in range(2):
+            limbs = []
+            for l in range(fp.NUM_LIMBS):
+                acc = None
+                for k in range(1, 16):
+                    g = int(_G_TABLE_AFF[c, k, l])
+                    if g == 0:
+                        continue
+                    term = masks[k] * g
+                    acc = term if acc is None else acc + term
+                limbs.append(jnp.zeros(shape, jnp.int32) if acc is None
+                             else acc)
+            out.append(fp.l_wrap(limbs, CURVE_P))
+        return tuple(out)
+
+    def q_pick_fn(dg):
+        masks = [(dg == k).astype(jnp.int32) for k in range(16)]
+        out = []
+        for c in range(3):
+            limbs = []
+            for l in range(fp.NUM_LIMBS):
+                acc = masks[1] * qtab_ref[0, c, l]
+                for k in range(2, 16):
+                    acc = acc + masks[k] * qtab_ref[k - 1, c, l]
+                limbs.append(acc)
+            out.append(fp.l_wrap(limbs, _JB))
+        return tuple(out)
+
+    def flatten(acc, started, exc):
+        return tuple(tuple(c.limbs) for c in acc) + (started, exc)
+
+    def round_body(k, carry):
+        acc = tuple(fp.l_wrap(limbs, _JB) for limbs in carry[:3])
+        started, exc = carry[3], carry[4]
+        acc, started, exc = _jac_round(acc, started, exc,
+                                       d1_ref[k], d2_ref[k],
+                                       g_pick_fn, q_pick_fn, fs)
+        return flatten(acc, started, exc)
+
+    acc0 = _jac_identity(qx_f.limbs[0])
+    z = jnp.zeros(shape, jnp.int32)
+    carry = jax.lax.fori_loop(0, _DIGITS, round_body, flatten(acc0, z, z))
+    acc = tuple(fp.l_wrap(limbs, _JB) for limbs in carry[:3])
+    started, exc = carry[3], carry[4]
+
+    rn_ok = flags_ref[0] != 0
+    valid = flags_ref[1] != 0
+    ok = _jac_final(acc, started, read_fl(rm_ref, CURVE_P),
+                    read_fl(rnm_ref, CURVE_P), rn_ok, valid, fs)
+    out_ref[...] = ok.astype(jnp.int32) + 2 * exc
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _verify_device_pallas_jac(d1, d2, qx, qy, r_m, rn_m, flags,
+                              tile: int = 1024, interpret: bool = False):
+    """Run the Jacobian ladder kernel; returns (ok, exc) bool (N,) arrays."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = qx.shape[1]
+    assert n % 128 == 0 and tile % 128 == 0 and n % tile == 0, (n, tile)
+    rows, sub = n // 128, tile // 128
+    grid = rows // sub
+
+    def rs(x):
+        return x.reshape(x.shape[0], rows, 128)
+
+    spec = lambda r: pl.BlockSpec(
+        (r, sub, 128), lambda i: (0, i, 0), memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        _ladder_kernel_jac,
+        grid=(grid,),
+        in_specs=[
+            spec(_DIGITS), spec(_DIGITS),
+            spec(fp.NUM_LIMBS), spec(fp.NUM_LIMBS),
+            spec(fp.NUM_LIMBS), spec(fp.NUM_LIMBS),
+            spec(2),
+        ],
+        out_specs=pl.BlockSpec((sub, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((15, 3, fp.NUM_LIMBS, sub, 128), jnp.int32)],
+        interpret=interpret,
+    )(rs(d1), rs(d2), rs(qx), rs(qy), rs(r_m), rs(rn_m), rs(flags))
+    out = out.reshape(n)
+    return (out & 1) != 0, (out & 2) != 0
+
+
+def _host_verify_prehashed(z: int, r: int, s: int, qx: int, qy: int) -> bool:
+    """Host oracle for exception-flagged lanes — the exact device
+    semantics: range checks, coordinate reduction mod p (fastecdsa
+    parity), on-curve check, then x(u₁G + u₂Q) ≡ r (mod n)."""
+    from ..core import curve as host_curve
+
+    if not (0 < r < CURVE_N and 0 < s < CURVE_N):
+        return False
+    if qx == 0 and qy == 0:
+        return False
+    qx, qy = qx % CURVE_P, qy % CURVE_P
+    if not is_on_curve((qx, qy)):
+        return False
+    w = pow(s, -1, CURVE_N)
+    u1, u2 = z * w % CURVE_N, r * w % CURVE_N
+    pt = host_curve.point_add(host_curve.point_mul(u1, host_curve.G),
+                              host_curve.point_mul(u2, (qx, qy)))
+    return pt is not None and pt[0] % CURVE_N == r
+
+
 PALLAS_STRICT = False  # True: never fall back (tests assert kernel health)
+# "jac" (fast, default) | "complete" (RCB16, for A/B).  Only consulted on
+# the production path (backend="pallas" + scalar_prep="device"); the
+# host-prep pallas branch always runs the RCB16 kernels (it exists for
+# the interpret-mode kernel test, which targets them explicitly).
+PALLAS_KERNEL = "jac"
 
 
 def _pallas_or_jnp(pallas_thunk, jnp_thunk) -> np.ndarray:
@@ -785,9 +1197,17 @@ def verify_batch(
 
 @functools.partial(jax.jit, static_argnames=("tile",))
 def _prep_and_verify_pallas(z, r, s, qx, qy, range_ok, rn_ok, tile: int):
-    """One dispatch: device scalar prep -> Pallas ladder kernel."""
+    """One dispatch: device scalar prep -> Pallas ladder kernel (RCB16)."""
     args = _scalar_prep(z, r, s, qx, qy, range_ok, rn_ok)
     return _verify_device_pallas(*args, tile=tile)
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def _prep_and_verify_pallas_jac(z, r, s, qx, qy, range_ok, rn_ok, tile: int):
+    """One dispatch: device scalar prep -> Jacobian ladder kernel.
+    Returns (ok, exc) — exception-flagged lanes need the host oracle."""
+    args = _scalar_prep(z, r, s, qx, qy, range_ok, rn_ok)
+    return _verify_device_pallas_jac(*args, tile=tile)
 
 
 @jax.jit
@@ -878,6 +1298,25 @@ def verify_batch_prehashed(
             jnp.asarray(np.pad(range_ok, (0, pad))),
             jnp.asarray(np.pad(rn_ok, (0, pad))),
         )
+        if backend == "pallas" and PALLAS_KERNEL == "jac":
+            def pallas_thunk():
+                ok, exc = _prep_and_verify_pallas_jac(
+                    *inputs, tile=_pick_tile(padded))
+                return np.stack([np.asarray(ok), np.asarray(exc)])
+
+            def jnp_thunk():
+                # the jnp fallback's complete formulas have no exceptions
+                ok = np.asarray(_prep_and_verify_jnp(*inputs))
+                return np.stack([ok, np.zeros_like(ok)])
+
+            res = _pallas_or_jnp(pallas_thunk, jnp_thunk)
+            out, exc = res[0], res[1]
+            if exc[:n].any():
+                out = out.copy()
+                for i in np.nonzero(exc[:n])[0]:
+                    out[i] = _host_verify_prehashed(
+                        zs[i], rs[i], ss[i], qxs[i], qys[i])
+            return out[:n]
         if backend == "pallas":
             out = _pallas_or_jnp(
                 lambda: _prep_and_verify_pallas(*inputs,
