@@ -1218,6 +1218,53 @@ def _prep_and_verify_jnp(z, r, s, qx, qy, range_ok, rn_ok):
                           flags[0] != 0, flags[1] != 0)
 
 
+def _pack_device_inputs(digests, signatures, pubkeys, padded: int):
+    """Host side of the device-prep path: sanitize scalars and pack them
+    into (8, padded) uint32 word lanes plus host-checked flags.  Returns
+    (device_inputs, zs, rs, ss, qxs, qys) — the python-int lists feed the
+    host oracle for exception-flagged lanes.  Split out so the bench can
+    pipeline this host stage against in-flight device batches (the
+    chain-sync ingest profile)."""
+    n = len(digests)
+    pad = padded - n
+
+    def lanes(xs):
+        return jnp.asarray(_pack_words(xs, pad))
+
+    def sane(x):  # out-of-[0, 2^256) scalars never reach the word packer
+        return x if 0 <= x < (1 << 256) else 0
+
+    def coord(x):
+        # the reference's fastecdsa computes everything mod p, so a
+        # coordinate in [p, 2^256) encodes the reduced point — accept
+        # it identically (consensus parity); reduce oversized/negative
+        # ints the way Python % does on the host oracle path
+        return x if 0 <= x < (1 << 256) else x % CURVE_P
+
+    # u1 depends only on z mod n, so oversized digests (a direct API
+    # caller hashing with sha512, say) reduce exactly like the host's
+    # z*w % n — never an exception where the host returns a verdict
+    zs = [z if z < (1 << 256) else z % CURVE_N
+          for z in (int.from_bytes(d, "big") for d in digests)]
+    rs = [sig[0] for sig in signatures]
+    ss = [sig[1] for sig in signatures]
+    qxs = [coord(pk[0]) for pk in pubkeys]
+    qys = [coord(pk[1]) for pk in pubkeys]
+    range_ok = np.array(
+        [0 < r_ < CURVE_N and 0 < s_ < CURVE_N
+         and not (qx_ == 0 and qy_ == 0)
+         for r_, s_, (qx_, qy_) in zip(rs, ss, pubkeys)], dtype=bool)
+    rn_ok = np.array([0 < r_ and r_ + CURVE_N < CURVE_P for r_ in rs],
+                     dtype=bool)
+    inputs = (
+        lanes(zs), lanes([sane(r_) for r_ in rs]),
+        lanes([sane(s_) for s_ in ss]), lanes(qxs), lanes(qys),
+        jnp.asarray(np.pad(range_ok, (0, pad))),
+        jnp.asarray(np.pad(rn_ok, (0, pad))),
+    )
+    return inputs, zs, rs, ss, qxs, qys
+
+
 def verify_batch_prehashed(
     digests: Sequence[bytes],
     signatures: Sequence[Tuple[int, int]],
@@ -1262,42 +1309,8 @@ def verify_batch_prehashed(
 
     if scalar_prep == "device":
         padded = _pad_to_block(n, pad_block)
-        pad = padded - n
-
-        def lanes(xs):
-            return jnp.asarray(_pack_words(xs, pad))
-
-        def sane(x):  # out-of-[0, 2^256) scalars never reach the word packer
-            return x if 0 <= x < (1 << 256) else 0
-
-        def coord(x):
-            # the reference's fastecdsa computes everything mod p, so a
-            # coordinate in [p, 2^256) encodes the reduced point — accept
-            # it identically (consensus parity); reduce oversized/negative
-            # ints the way Python % does on the host oracle path
-            return x if 0 <= x < (1 << 256) else x % CURVE_P
-
-        # u1 depends only on z mod n, so oversized digests (a direct API
-        # caller hashing with sha512, say) reduce exactly like the host's
-        # z*w % n — never an exception where the host returns a verdict
-        zs = [z if z < (1 << 256) else z % CURVE_N
-              for z in (int.from_bytes(d, "big") for d in digests)]
-        rs = [sig[0] for sig in signatures]
-        ss = [sig[1] for sig in signatures]
-        qxs = [coord(pk[0]) for pk in pubkeys]
-        qys = [coord(pk[1]) for pk in pubkeys]
-        range_ok = np.array(
-            [0 < r_ < CURVE_N and 0 < s_ < CURVE_N
-             and not (qx_ == 0 and qy_ == 0)
-             for r_, s_, (qx_, qy_) in zip(rs, ss, pubkeys)], dtype=bool)
-        rn_ok = np.array([0 < r_ and r_ + CURVE_N < CURVE_P for r_ in rs],
-                         dtype=bool)
-        inputs = (
-            lanes(zs), lanes([sane(r_) for r_ in rs]),
-            lanes([sane(s_) for s_ in ss]), lanes(qxs), lanes(qys),
-            jnp.asarray(np.pad(range_ok, (0, pad))),
-            jnp.asarray(np.pad(rn_ok, (0, pad))),
-        )
+        inputs, zs, rs, ss, qxs, qys = _pack_device_inputs(
+            digests, signatures, pubkeys, padded)
         if backend == "pallas" and PALLAS_KERNEL == "jac":
             def pallas_thunk():
                 ok, exc = _prep_and_verify_pallas_jac(
