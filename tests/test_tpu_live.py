@@ -1,0 +1,90 @@
+"""Live-hardware kernel checks — run only with UPOW_TPU_TESTS=1 on a host
+whose TPU tunnel is healthy (the default suite pins JAX to CPU; see
+conftest.py).  These cover the assembled Pallas kernels end-to-end, which
+the CPU suite can only cover via their eager twins / jnp fallbacks.
+
+    UPOW_TPU_TESTS=1 python -m pytest tests/test_tpu_live.py -q
+"""
+
+import hashlib
+import os
+import random
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("UPOW_TPU_TESTS"),
+    reason="live-TPU tests; set UPOW_TPU_TESTS=1 on a healthy tunnel")
+
+
+@pytest.fixture(scope="module")
+def tpu():
+    import jax
+
+    if jax.default_backend() == "cpu":
+        pytest.skip("no accelerator backend")
+    return jax.devices()[0]
+
+
+def test_jac_kernel_differential_on_chip(tpu):
+    from upow_tpu.core import curve
+    from upow_tpu.core.constants import CURVE_N
+    from upow_tpu.crypto import p256
+
+    rng = random.Random(17)
+    msgs, sigs, pubs = [], [], []
+    for i in range(64):
+        d, pub = curve.keygen(rng=rng.randrange(1, CURVE_N))
+        m = bytes([i % 256]) * (5 + i % 31)
+        r, s = curve.sign(m, d)
+        if i % 4 == 3:
+            s = (s + 1) % CURVE_N
+        if i % 9 == 5:
+            sigs.append((r, CURVE_N - s))
+            msgs.append(m)
+            pubs.append(pub)
+            continue
+        msgs.append(m)
+        sigs.append((r, s))
+        pubs.append(pub)
+    digests = [hashlib.sha256(m).digest() for m in msgs]
+    want = [curve.verify(sig, m, pk) for sig, m, pk in zip(sigs, msgs, pubs)]
+
+    old = p256.PALLAS_STRICT
+    p256.PALLAS_STRICT = True  # a kernel failure must fail, not fall back
+    try:
+        got = p256.verify_batch_prehashed(
+            digests, sigs, pubs, pad_block=128, backend="pallas",
+            scalar_prep="device")
+    finally:
+        p256.PALLAS_STRICT = old
+    assert list(got) == want
+
+
+def test_pow_search_kernel_on_chip(tpu):
+    from upow_tpu.core import curve, point_to_string
+    from upow_tpu.core.header import BlockHeader
+    from upow_tpu.core.merkle import merkle_root
+    from upow_tpu.crypto import SENTINEL, make_template, target_spec
+    from upow_tpu.crypto import sha256 as sk
+
+    _, pub = curve.keygen(rng=0xFACE)
+    header = BlockHeader(
+        previous_hash=bytes(range(32)).hex(),
+        address=point_to_string(pub),
+        merkle_root=merkle_root([]),
+        timestamp=1_753_791_000,
+        difficulty_x10=10,
+        nonce=0,
+    )
+    template = make_template(header.prefix_bytes())
+    spec = target_spec(header.previous_hash, "1.0")
+    hit = int(sk.pow_search_pallas(template, spec, nonce_base=0,
+                                   batch=1 << 18))
+    assert hit != int(SENTINEL)
+    digest = hashlib.sha256(
+        header.prefix_bytes() + hit.to_bytes(4, "little")).hexdigest()
+    from upow_tpu.core.difficulty import check_pow_hash
+
+    assert check_pow_hash(digest, header.previous_hash, "1.0")
