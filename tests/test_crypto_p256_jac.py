@@ -13,9 +13,11 @@ exercised on real TPU by bench_suite config 3 and an in-session
 differential against the host oracle.
 """
 
+import os
 import random
 
 import numpy as np
+import pytest
 
 from upow_tpu.core import curve
 from upow_tpu.core.constants import CURVE_N, CURVE_P
@@ -260,6 +262,72 @@ def test_ladder_rn_wraparound_acceptance():
                              rn_vals=[True, False])
     assert not exc.any()
     assert list(ok) == [True, False]
+
+
+def test_ladder_fuzz_random_digits_vs_oracle():
+    """Randomized 4-round ladders across many lanes: verdicts must match
+    the oracle point exactly, with zero spurious exception flags (the
+    digit space is tiny, so collisions would need acc ≡ pick mod n —
+    impossible below wraparound)."""
+    Q = _rand_pt()
+    n, rounds = 24, 4
+    d1 = [[rng.randrange(16) for _ in range(n)] for _ in range(rounds)]
+    d2 = [[rng.randrange(16) for _ in range(n)] for _ in range(rounds)]
+    _, _, expected = _run_ladder(d1, d2, Q)
+    r_vals = []
+    for j, pt in enumerate(expected):
+        if pt is None:
+            r_vals.append(1)
+        elif j % 3 == 0:
+            r_vals.append((pt[0] + 1) % CURVE_P)   # wrong x -> reject
+        else:
+            r_vals.append(pt[0])
+    ok, exc, _ = _run_ladder(d1, d2, Q, r_vals=r_vals)
+    assert not exc.any()
+    for j, pt in enumerate(expected):
+        want = pt is not None and j % 3 != 0
+        assert bool(ok[j]) == want, (j, pt)
+
+
+def test_full_ladder_real_signatures_eager():
+    """The eager twin at full 256-bit scale with real signature-derived
+    digits — the exact data shape the Pallas kernel sees on TPU."""
+    import hashlib
+
+    from upow_tpu.crypto import fp as _fp
+
+    msgs, sigs, pubs = [], [], []
+    for i in range(8):
+        d, pub = curve.keygen(rng=6200 + i)
+        m = bytes([i]) * 12
+        r, s = curve.sign(m, d)
+        if i % 3 == 2:
+            s = (s + 1) % CURVE_N
+        msgs.append(m)
+        sigs.append((r, s))
+        pubs.append(pub)
+    want = [curve.verify(sig, m, pk) for sig, m, pk in zip(sigs, msgs, pubs)]
+
+    u1s, u2s, rms, rnms, rn_oks = [], [], [], [], []
+    for m, (r, s) in zip(msgs, sigs):
+        z = int.from_bytes(hashlib.sha256(m).digest(), "big")
+        w = pow(s, -1, CURVE_N)
+        u1s.append(z * w % CURVE_N)
+        u2s.append(r * w % CURVE_N)
+        rms.append(fp.to_mont(r, _FS))
+        rnms.append(fp.to_mont((r + CURVE_N) % CURVE_P, _FS))
+        rn_oks.append(r + CURVE_N < CURVE_P)
+    d1 = p256._scalar_digits(u1s)
+    d2 = p256._scalar_digits(u2s)
+    qx = _fp.ints_to_limbs([fp.to_mont(pk[0], _FS) for pk in pubs])
+    qy = _fp.ints_to_limbs([fp.to_mont(pk[1], _FS) for pk in pubs])
+    rm = _fp.ints_to_limbs(rms)
+    rnm = _fp.ints_to_limbs(rnms)
+    ok, exc = p256._jac_verify_eager(
+        d1, d2, qx, qy, rm, rnm, np.asarray(rn_oks),
+        np.ones(len(msgs), dtype=bool))
+    assert not exc.any()
+    assert list(ok) == want
 
 
 # --- wrapper fallback plumbing --------------------------------------------
