@@ -68,6 +68,49 @@ def _rand_pt():
     return curve.point_mul(rng.randrange(1, CURVE_N), curve.G)
 
 
+def test_mont_reduce_sweep_margin_worst_case():
+    """The Montgomery tail runs 1 pre-/2 post-sweeps on an int32 overflow
+    budget (see fp._l_mont_reduce docstring).  Drive it with the worst
+    representation the pipeline can produce — every limb at the post-sweep
+    cap (2^13 + 2^4) — through mul, sqr and chained add/sub + mul, against
+    exact bigints."""
+    cap = (1 << fp.LIMB_BITS) + 22  # the stacked layout's true worst limb
+    shape = (4,)
+    # caps on limbs 0..16, ones above: every accumulation row still sums
+    # near-cap products, while the VALUE (~2^260) stays inside the
+    # pipeline's envelope (all-limbs-at-cap would encode ~2^273 — beyond
+    # any reachable bound, where sweeps may legitimately drop carries)
+    limb_vals = [cap] * 17 + [1] * (fp.NUM_LIMBS - 17)
+    worst = fp.l_wrap([np.full(shape, v, np.int32) for v in limb_vals],
+                      1 << 261)
+    worst_val = sum(v << (fp.LIMB_BITS * i) for i, v in enumerate(limb_vals))
+    r_inv = pow(1 << fp.R_BITS, -1, CURVE_P)
+
+    got = _fl_ints(fp.l_mont_mul(worst, worst, _FS))
+    want = worst_val * worst_val * r_inv % CURVE_P
+    assert got == [want] * 4
+    assert _fl_ints(fp.l_mont_sqr(worst, _FS)) == [want] * 4
+
+    # stacked layout shares the same sweep budget
+    import jax.numpy as jnp
+
+    arr = jnp.asarray(np.stack([np.full(shape, v, np.int32)
+                                for v in limb_vals]))
+    got_s = fp.limbs_to_ints(np.asarray(
+        fp.canon(fp.mont_mul(fp.wrap(arr, 1 << 261),
+                             fp.wrap(arr, 1 << 261), _FS), _FS)))
+    assert got_s == [want] * 4
+
+    # chained: (worst + worst - small) * worst, exact vs bigint
+    small = fp.l_wrap([np.full(shape, 3, np.int32)] +
+                      [np.zeros(shape, np.int32)] * (fp.NUM_LIMBS - 1),
+                      CURVE_P)
+    t = fp.l_sub(fp.l_add(worst, worst), small, _FS)
+    got2 = _fl_ints(fp.l_mont_mul(t, worst, _FS))
+    want2 = (2 * worst_val - 3) * worst_val * r_inv % CURVE_P
+    assert got2 == [want2] * 4
+
+
 # --- formulas -------------------------------------------------------------
 
 def test_jac_dbl_matches_oracle():

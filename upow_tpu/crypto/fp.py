@@ -115,8 +115,12 @@ class FE:
     """Field-element batch: (21, N) int32 limbs + static value bound.
 
     ``bound`` is exclusive, tracked in Python while tracing — it never
-    touches the device.  Limbs are in [0, 2^13] (8192 inclusive, the
-    post-sweep residue), values are >= 0 and < bound.
+    touches the device.  Stacked-layout limbs are in [0, 2^13 + 22]
+    (the residue after mont_mul's two one-hop sweeps: 8191 + a round-2
+    carry of at most 22); limb-list (FL) limbs are in [0, 2^13 − 1]
+    (:func:`_l_sweep` is a full ripple).  Values are >= 0 and < bound.
+    21-term product accumulations stay < 2^31 at either cap
+    (21 · 8213² ≈ 1.42e9).
     """
 
     arr: jnp.ndarray
@@ -220,7 +224,10 @@ def mont_mul(a: FE, b: FE, fs: FieldSpec) -> FE:
     t = jnp.zeros((2 * L, n), dtype=jnp.int32)
     for i in range(L):
         t = _shift_add(t, a.arr[i] * b.arr, i)
-    t = _sweep(t, 3)
+    # sweep counts: pre 1 one-hop round (rows ≤ 2^13 + 2^17.4; the
+    # reduction-round budget in _l_mont_reduce's proof absorbs it);
+    # post 2 one-hop rounds (limbs ≤ 2^13 + 22 — see the FE docstring)
+    t = _sweep(t, 1)
     # Montgomery rounds: zero the bottom L limbs; the single-limb carry per
     # round keeps m exact (t[i] ≡ value/b^i mod b at round i).  p's limbs
     # enter as scalar constants (Pallas-legal; see FieldSpec.p_limbs).
@@ -229,7 +236,7 @@ def mont_mul(a: FE, b: FE, fs: FieldSpec) -> FE:
         mp = jnp.stack([m * pl for pl in fs.p_limbs])
         t = _shift_add(t, mp, i)
         t = _shift_add(t, (t[i] >> LIMB_BITS)[None], i + 1)
-    out = _sweep(t[L:], 3)
+    out = _sweep(t[L:], 2)
     return FE(out, a.bound * b.bound // (1 << R_BITS) + 2 * fs.p)
 
 
@@ -313,17 +320,34 @@ def l_sub(a: FL, b: FL, fs: FieldSpec) -> FL:
 def _l_mont_reduce(t: list, bound_product: int, fs: FieldSpec) -> FL:
     """Shared tail of the limb-list Montgomery entry points: sweep the
     double-width accumulator, run the 21 reduction rounds, sweep the top
-    half.  ``t`` rows may be None (rows no product reached)."""
+    half.  ``t`` rows may be None (rows no product reached).
+
+    Sweep-count proof (int32 overflow is the only constraint — m's
+    exactness needs just "every contribution into row i lands before
+    round i", which product accumulation + the single round-carry chain
+    guarantee at any sweep count).  Unlike the stacked :func:`_sweep`
+    (one carry hop per round), :func:`_l_sweep` is a full sequential
+    ripple — ONE round leaves every limb ≤ 2¹³ − 1:
+
+    * pre-sweep 1: raw rows ≤ 21·2²⁶ ≈ 2³⁰·⁴ — one ripple normalizes.
+      Each reduction round then adds ≤ 21 m·p products (< 2²⁶ each)
+      plus one carry (< 2¹⁸) to a row — worst row value
+      2¹³ + 21·2²⁶ + 2¹⁸ < 2³⁰·⁵ < 2³¹.  (A formula accumulating more
+      than NUM_LIMBS products per row would break this — re-derive
+      before changing the multiply structure.)
+    * post-sweep 1: the output rows (≤ 2³⁰·⁵) ripple back to ≤ 2¹³ − 1
+      in one round, restoring the canonical limb range.
+    """
     L = NUM_LIMBS
     sample = next(x for x in t if x is not None)
     t = [_xp(sample).zeros_like(sample) if r is None else r for r in t]
-    t = _l_sweep(t, 3)
+    t = _l_sweep(t, 1)
     for i in range(L):
         m = (t[i] * fs.pinv) & LIMB_MASK
         for j in range(L):
             t[i + j] = t[i + j] + m * fs.p_limbs[j]
         t[i + 1] = t[i + 1] + (t[i] >> LIMB_BITS)
-    out = _l_sweep(t[L:], 3)
+    out = _l_sweep(t[L:], 1)
     return FL(tuple(out), bound_product // (1 << R_BITS) + 2 * fs.p)
 
 
