@@ -13,11 +13,9 @@ exercised on real TPU by bench_suite config 3 and an in-session
 differential against the host oracle.
 """
 
-import os
 import random
 
 import numpy as np
-import pytest
 
 from upow_tpu.core import curve
 from upow_tpu.core.constants import CURVE_N, CURVE_P
