@@ -1,0 +1,44 @@
+import hashlib
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+import jax
+
+from upow_tpu import compile_cache
+
+compile_cache.enable("/root/repo/.jax_cache")
+from upow_tpu.core import curve
+from upow_tpu.crypto import p256 as P
+
+msgs, sigs, pubs = [], [], []
+for i in range(256):
+    d, pub = curve.keygen(rng=7000 + i)
+    m = i.to_bytes(4, "big") * 8
+    sigs.append(curve.sign(m, d))
+    msgs.append(m)
+    pubs.append(pub)
+k = 8192 // 256
+msgs, sigs, pubs = msgs * k, sigs * k, pubs * k
+digests = [hashlib.sha256(m).digest() for m in msgs]
+inputs, *_meta = P._pack_device_inputs(digests, sigs, pubs, 8192)
+
+for tile in (1024, 2048, 4096):
+    try:
+        fn = lambda: P._prep_and_verify_pallas_jac(*inputs, tile=tile)
+        ok, exc = fn()
+        ok = np.asarray(ok)
+        assert ok.all() and not np.asarray(exc).any()
+        jax.block_until_ready(fn())
+        t0 = time.perf_counter()
+        reps = 0
+        while time.perf_counter() - t0 < 5:
+            jax.block_until_ready(fn())
+            reps += 1
+        dt = time.perf_counter() - t0
+        print(f"tile={tile}: {reps*8192/dt:,.0f} sigs/s "
+              f"({dt/reps*1e3:.1f} ms/batch)", flush=True)
+    except Exception as e:
+        print(f"tile={tile}: FAILED {type(e).__name__}: {e}", flush=True)
