@@ -102,6 +102,97 @@ def make_send(state, sender_d, sender_addr, to_addr, amount, message=None):
     return _build()
 
 
+def test_check_block_header_rejection_matrix(keys):
+    """Every header-level rejection branch of check_block
+    (manager.py:422-647 parity): malformed content, bad PoW, wrong
+    previous hash, non-monotone / future timestamps, oversized body,
+    merkle mismatch — each by its error string, and the good block
+    still accepts afterwards (no state pollution)."""
+
+    async def scenario():
+        from upow_tpu.core import clock
+        from upow_tpu.core.difficulty import BLOCK_TIME
+
+        state = ChainState()
+        manager = BlockManager(state, sig_backend="host")
+        addr = keys["a1"]
+        await mine_and_accept(manager, state, addr)
+        clock.advance(BLOCK_TIME)
+        await mine_and_accept(manager, state, addr)
+        clock.advance(BLOCK_TIME)
+
+        difficulty, last_block = await manager.calculate_difficulty()
+
+        def header(**kw):
+            h = BlockHeader(
+                previous_hash=kw.get("prev", last_block["hash"]),
+                address=addr,
+                merkle_root=kw.get("merkle", merkle_root([])),
+                timestamp=kw.get("ts", timestamp()),
+                difficulty_x10=int(difficulty * 10),
+                nonce=0,
+            )
+            job = MiningJob(h.prefix_bytes(), h.previous_hash, difficulty)
+            if kw.get("mine", True):
+                r = mine(job, "python", batch=1 << 14, ttl=300)
+                h.nonce = r.nonce
+            return h
+
+        async def expect_reject(content, txs, needle):
+            errors = []
+            ok = await manager.check_block(content, txs, errors=errors)
+            assert not ok and any(needle in e for e in errors), (needle,
+                                                                errors)
+
+        await expect_reject("zz-not-hex", [], "malformed")
+        await expect_reject(header(mine=False).hex(), [], "not valid")
+        # PoW is checked against the CHAIN's previous hash, so a wrong
+        # prev rarely passes PoW; craft one mined against the real prev
+        # but claiming another parent
+        bogus_prev = "11" * 32
+        good = header()
+        forged = BlockHeader(
+            previous_hash=bogus_prev, address=addr,
+            merkle_root=good.merkle_root, timestamp=good.timestamp,
+            difficulty_x10=good.difficulty_x10, nonce=good.nonce)
+        errors = []
+        ok = await manager.check_block(forged.hex(), [], errors=errors)
+        assert not ok  # either PoW or prev-hash mismatch — both reject
+
+        await expect_reject(header(ts=last_block["timestamp"]).hex(), [],
+                            "timestamp younger")
+        await expect_reject(header(ts=timestamp() + 3600).hex(), [],
+                            "timestamp in the future")
+
+        # oversized: fake transactions bigger than MAX_BLOCK_SIZE_HEX
+        class FatTx:
+            is_coinbase = False
+
+            def __init__(self, n):
+                self._hex = "ab" * n
+
+            def hex(self):
+                return self._hex
+
+        from upow_tpu.core.constants import MAX_BLOCK_SIZE_HEX
+
+        fat = [FatTx(MAX_BLOCK_SIZE_HEX // 2 + 8) for _ in range(2)]
+        await expect_reject(header().hex(), fat, "too big")
+
+        await expect_reject(header(merkle="ff" * 32).hex(), [],
+                            "merkle")
+
+        # and a clean block still accepts (nothing above polluted state)
+        clock.advance(BLOCK_TIME)
+        await mine_and_accept(manager, state, addr)
+
+    from upow_tpu.core import clock as _clock
+    try:
+        asyncio.run(scenario())
+    finally:
+        _clock.reset()
+
+
 def test_genesis_then_spend_then_reorg(keys):
     async def scenario():
         state = ChainState()
