@@ -538,3 +538,63 @@ def test_governance_randomized_churn():
         run(scenario())
     finally:
         _diff.next_difficulty = orig_next
+
+
+def test_wallet_cli_end_to_end(tmp_path, capsys):
+    """The actual CLI entry (`python -m upow_tpu.wallet.cli` surface,
+    reference wallet.py:44-62): createwallet -> fund the key on a
+    file-backed chain -> balance -> send with the node unreachable
+    (falls back to the local mempool, wallet.py:243-252 parity) -> the
+    pending tx mines and the recipient balance moves."""
+    from upow_tpu.wallet import cli
+
+    wallet_file = str(tmp_path / "key_pair_list.json")
+    db_file = str(tmp_path / "chain.db")
+
+    async def scenario():
+        # createwallet
+        rc = await cli.amain(["createwallet", "--wallet", wallet_file,
+                              "--db", db_file, "--node", ""])
+        assert rc == 0
+        store = KeyStore(wallet_file)
+        d = int(store.keys()[0]["private_key"])
+        addr = point_to_string(curve.point_mul(d, curve.G))
+
+        # fund it: two blocks to the CLI key's address
+        state = ChainState(db_file)
+        manager = BlockManager(state, sig_backend="host")
+        await mine_block(manager, state, addr)
+        await mine_block(manager, state, addr)
+
+        # balance shows the coinbase rewards
+        rc = await cli.amain(["balance", "--wallet", wallet_file,
+                              "--db", db_file, "--node", ""])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert addr in out and "Total Balance: 12" in out
+
+        # send to a fresh key; node URL unreachable -> local mempool
+        d2, pub2 = curve.keygen(rng=31337)
+        dest = point_to_string(pub2)
+        rc = await cli.amain([
+            "send", "-to", dest, "-a", "2.5", "-m", "cli e2e",
+            "--wallet", wallet_file, "--db", db_file,
+            "--node", "http://127.0.0.1:9"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "local mempool" in out
+        pending = await state.get_pending_transactions_limit(hex_only=False)
+        assert len(pending) == 1
+
+        # mine it in; recipient balance moves
+        await mine_block(manager, state, addr, include_pending=True)
+        bal = await state.get_address_balance(dest)
+        assert bal == int(Decimal("2.5") * SMALLEST)
+
+        # error paths: missing wallet key file elsewhere
+        rc = await cli.amain(["send", "-to", dest, "-a", "1",
+                              "--wallet", str(tmp_path / "none.json"),
+                              "--db", db_file, "--node", ""])
+        assert rc == 1
+
+    run(scenario())
