@@ -763,3 +763,67 @@ def test_mining_info_ten_tx_template(tmp_path, keys):
         assert info["merkle_root"] == _mr(first_ten)
 
     run_cluster(tmp_path, scenario)
+
+
+def test_launcher_boots_from_config_alone(tmp_path):
+    """`python -m upow_tpu.node.run --config cfg.json` in a real child
+    process: the node must come up from config alone (SURVEY §5 config
+    axis), serve the API, and shut down cleanly on SIGTERM."""
+    import json as _json
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    cfg = {
+        "node": {
+            "port": port,
+            "db_path": str(tmp_path / "boot.db"),
+            "seed_url": "",
+            "peers_file": str(tmp_path / "nodes.json"),
+            "ip_config_file": "",
+        },
+        "device": {"sig_backend": "host"},
+        "log": {"path": str(tmp_path / "app.log"), "console": False},
+    }
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(_json.dumps(cfg))
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "upow_tpu.node.run", "--config",
+         str(cfg_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        deadline = time.time() + 60
+        last_err = None
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "launcher died: "
+                    + proc.stderr.read().decode(errors="replace")[-2000:])
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/get_mining_info",
+                        timeout=2) as resp:
+                    body = _json.loads(resp.read())
+                break
+            except Exception as e:  # noqa: BLE001 - retry until deadline
+                last_err = e
+                time.sleep(0.5)
+        else:
+            raise AssertionError(f"node never came up: {last_err}")
+        assert body["ok"] and "difficulty" in body["result"]
+        # the rotating-file logger wrote where config said
+        assert (tmp_path / "app.log").exists()
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise AssertionError("node did not exit on SIGTERM")
