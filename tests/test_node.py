@@ -794,10 +794,14 @@ def test_launcher_boots_from_config_alone(tmp_path):
     cfg_path = tmp_path / "cfg.json"
     cfg_path.write_text(_json.dumps(cfg))
 
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "upow_tpu.node.run", "--config",
-         str(cfg_path)],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    # child output goes to a file, not pipes — an undrained pipe can
+    # block the child (and proc.wait) once the ~64 KiB buffer fills
+    child_log = tmp_path / "child.log"
+    with open(child_log, "wb") as sink:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "upow_tpu.node.run", "--config",
+             str(cfg_path)],
+            stdout=sink, stderr=subprocess.STDOUT)
     try:
         deadline = time.time() + 60
         last_err = None
@@ -805,7 +809,7 @@ def test_launcher_boots_from_config_alone(tmp_path):
             if proc.poll() is not None:
                 raise AssertionError(
                     "launcher died: "
-                    + proc.stderr.read().decode(errors="replace")[-2000:])
+                    + child_log.read_bytes().decode(errors="replace")[-2000:])
             try:
                 with urllib.request.urlopen(
                         f"http://127.0.0.1:{port}/get_mining_info",

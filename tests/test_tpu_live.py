@@ -62,6 +62,42 @@ def test_jac_kernel_differential_on_chip(tpu):
     assert list(got) == want
 
 
+def test_jac_kernel_mesh_sharded_on_chip(tpu):
+    """shard_map-wrapped jac kernel over a device mesh (single chip here;
+    the same program spans a v5e-8 unchanged — per-device pallas_call on
+    the local shard, no collectives)."""
+    import jax
+
+    from upow_tpu.core import curve
+    from upow_tpu.core.constants import CURVE_N
+    from upow_tpu.crypto import p256
+    from upow_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(jax.devices()[:1])
+    msgs, sigs, pubs = [], [], []
+    for i in range(16):
+        d, pub = curve.keygen(rng=4400 + i)
+        m = bytes([i]) * 10
+        r, s = curve.sign(m, d)
+        if i % 4 == 1:
+            r = (r + 1) % CURVE_N
+        msgs.append(m)
+        sigs.append((r, s))
+        pubs.append(pub)
+    digests = [hashlib.sha256(m).digest() for m in msgs]
+    want = [curve.verify(sig, m, pk) for sig, m, pk in zip(sigs, msgs, pubs)]
+
+    old = p256.PALLAS_STRICT
+    p256.PALLAS_STRICT = True
+    try:
+        got = p256.verify_batch_prehashed(
+            digests, sigs, pubs, pad_block=128, backend="pallas",
+            scalar_prep="device", mesh=mesh)
+    finally:
+        p256.PALLAS_STRICT = old
+    assert list(got) == want
+
+
 def test_pow_search_kernel_on_chip(tpu):
     from upow_tpu.core import curve, point_to_string
     from upow_tpu.core.header import BlockHeader

@@ -1210,6 +1210,33 @@ def _prep_and_verify_pallas_jac(z, r, s, qx, qy, range_ok, rn_ok, tile: int):
     return _verify_device_pallas_jac(*args, tile=tile)
 
 
+@functools.partial(jax.jit, static_argnames=("tile", "mesh"))
+def _prep_and_verify_pallas_jac_sharded(z, r, s, qx, qy, range_ok, rn_ok,
+                                        tile: int, mesh):
+    """Mesh-DP variant: every device runs scalar prep + the Pallas ladder
+    on its own batch shard (the program is elementwise over lanes, so the
+    only communication is the output gather).  ``shard_map`` is required
+    — pallas_call has no SPMD partitioning rule, so plain jit + sharded
+    inputs cannot split it."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import shard_map_compat
+
+    shard_map, check_kw = shard_map_compat()
+
+    def per_device(z_, r_, s_, qx_, qy_, range_ok_, rn_ok_):
+        args = _scalar_prep(z_, r_, s_, qx_, qy_, range_ok_, rn_ok_)
+        return _verify_device_pallas_jac(*args, tile=tile)
+
+    lanes = P(None, "dp")
+    flat = P("dp")
+    return shard_map(
+        per_device, mesh=mesh,
+        in_specs=(lanes, lanes, lanes, lanes, lanes, flat, flat),
+        out_specs=(flat, flat), **check_kw,
+    )(z, r, s, qx, qy, range_ok, rn_ok)
+
+
 @jax.jit
 def _prep_and_verify_jnp(z, r, s, qx, qy, range_ok, rn_ok):
     d1, d2, qxm, qym, rmp, rnmp, flags = _scalar_prep(
@@ -1277,8 +1304,10 @@ def verify_batch_prehashed(
     """``mesh``: a jax.sharding.Mesh — the padded batch is placed with
     its lane axis sharded over the mesh ("dp"), so the elementwise
     verify program runs SPMD with zero collectives (SURVEY §2.3 DP
-    verify).  Without it, inputs live on one device.  Only the jnp
-    backend shards this way (the pallas kernel's grid is per-device).
+    verify).  Without it, inputs live on one device.  The jnp backend
+    shards via plain jit; the pallas backend (jac kernel + device prep)
+    wraps the kernel in shard_map — pallas_call has no partitioning
+    rule, so each device runs the grid on its own shard.
 
     ``scalar_prep``: "device" moves s⁻¹ mod n, u₁/u₂, Montgomery
     conversions, the on-curve check and digit extraction into the jitted
@@ -1300,10 +1329,17 @@ def verify_batch_prehashed(
     if scalar_prep is None:
         scalar_prep = "device" if jax.default_backend() == "tpu" else "host"
     if mesh is not None and backend == "pallas":
-        raise ValueError(
-            "mesh sharding is only wired for the jnp backend; pass "
-            "backend='jnp' (the pallas kernel runs one device's shard)")
-    if backend == "pallas":
+        if PALLAS_KERNEL != "jac" or scalar_prep != "device":
+            raise ValueError(
+                "mesh + pallas is wired for the jac kernel with device "
+                "scalar prep; pass backend='jnp' otherwise")
+        import math
+
+        # the one real invariant: padded must be a multiple of
+        # 128 * n_dev, so every device's shard fills whole kernel tiles
+        unit = 128 * mesh.devices.size
+        pad_block = pad_block * unit // math.gcd(pad_block, unit)
+    elif backend == "pallas":
         # the limb-list kernel reshapes the batch axis to (rows, 128)
         pad_block = max(pad_block, 128)
 
@@ -1312,13 +1348,25 @@ def verify_batch_prehashed(
         inputs, zs, rs, ss, qxs, qys = _pack_device_inputs(
             digests, signatures, pubkeys, padded)
         if backend == "pallas" and PALLAS_KERNEL == "jac":
+            if mesh is not None:
+                from ..parallel.mesh import shard_batch_arrays
+
+                inputs = shard_batch_arrays(mesh, *inputs)
+
             def pallas_thunk():
-                ok, exc = _prep_and_verify_pallas_jac(
-                    *inputs, tile=_pick_tile(padded))
+                if mesh is not None:
+                    ok, exc = _prep_and_verify_pallas_jac_sharded(
+                        *inputs,
+                        tile=_pick_tile(padded // mesh.devices.size),
+                        mesh=mesh)
+                else:
+                    ok, exc = _prep_and_verify_pallas_jac(
+                        *inputs, tile=_pick_tile(padded))
                 return np.stack([np.asarray(ok), np.asarray(exc)])
 
             def jnp_thunk():
                 # the jnp fallback's complete formulas have no exceptions
+                # (sharded inputs partition the plain-jit program too)
                 ok = np.asarray(_prep_and_verify_jnp(*inputs))
                 return np.stack([ok, np.zeros_like(ok)])
 

@@ -51,17 +51,24 @@ def shard_bounds(total_lo: int, total_hi: int, index: int, count: int) -> Tuple[
     return (total_lo + span * index // count, total_lo + span * (index + 1) // count)
 
 
+def shard_map_compat():
+    """(shard_map, disable-check kwargs) across jax versions: >= 0.8 has
+    jax.shard_map with check_vma; older ships the experimental module
+    with check_rep."""
+    try:
+        from jax import shard_map  # jax >= 0.8
+        return shard_map, {"check_vma": False}
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+        return shard_map, {"check_rep": False}
+
+
 @functools.partial(
     jax.jit, static_argnames=("batch_per_device", "nonce_spec", "spec", "mesh")
 )
 def _pow_search_mesh(midstate, tail_words, nonce_base, batch_per_device: int,
                      nonce_spec, spec: TargetSpec, mesh: Mesh):
-    try:
-        from jax import shard_map  # jax >= 0.8 (check_vma kwarg)
-        check_kw = {"check_vma": False}
-    except ImportError:  # pragma: no cover - older jax (check_rep kwarg)
-        from jax.experimental.shard_map import shard_map
-        check_kw = {"check_rep": False}
+    shard_map, check_kw = shard_map_compat()
 
     def per_device(mid, tail, base):
         idx = jax.lax.axis_index("dp")
