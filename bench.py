@@ -161,24 +161,21 @@ def main() -> int:
         # pipelined measurement: keep `depth` dispatches in flight so the
         # chip never idles on the host round-trip (the production
         # engine.mine loop does the same; ~2x on a tunneled chip)
+        from upow_tpu.benchutil import pipelined_loop
         from upow_tpu.trace import profile
 
+        base = [0]
+
+        def dispatch():
+            r = search(template, spec, nonce_base=base[0], batch=args.batch)
+            base[0] = (base[0] + args.batch) % (1 << 32)
+            return r
+
         with profile(args.trace_dir):
-            t0 = time.perf_counter()
-            hashes = 0
-            base = 0
-            inflight = []
-            while time.perf_counter() - t0 < args.seconds or inflight:
-                while (len(inflight) < max(1, args.depth)
-                       and time.perf_counter() - t0 < args.seconds):
-                    inflight.append(search(template, spec, nonce_base=base,
-                                           batch=args.batch))
-                    base = (base + args.batch) % (1 << 32)
-                if not inflight:  # deadline crossed between the time checks
-                    break
-                _ = int(inflight.pop(0))  # block on the oldest round
-                hashes += args.batch
-            mhs = hashes / (time.perf_counter() - t0) / 1e6
+            rounds, elapsed = pipelined_loop(
+                dispatch, lambda r: int(r), args.seconds,
+                depth=max(1, args.depth))
+            mhs = rounds * args.batch / elapsed / 1e6
 
     baseline = _baseline_python_mhs(header.prefix_bytes())
     print(json.dumps({

@@ -185,23 +185,17 @@ def config3_batch_verify(seconds: float):
             inputs, *_meta = P._pack_device_inputs(digests, sigs, pubs, 8192)
             return P._prep_and_verify_pallas_jac(*inputs, tile=tile)
 
+        def check(res):
+            ok, exc = (np.asarray(a) for a in res)
+            assert bool(ok.all()) and not bool(exc.any())
+
         try:
             jax.block_until_ready(dispatch())  # warm
-            t0 = time.perf_counter()
-            reps = 0
-            inflight = []
-            while time.perf_counter() - t0 < seconds or inflight:
-                if (len(inflight) < depth
-                        and time.perf_counter() - t0 < seconds):
-                    inflight.append(dispatch())
-                    continue
-                ok, exc = inflight.pop(0)
-                ok, exc = np.asarray(ok), np.asarray(exc)
-                assert bool(ok.all()) and not bool(exc.any())
-                reps += 1
-            prate = reps * 8192 / (time.perf_counter() - t0)
-            _emit(f"verify_8k_pipelined_{_platform()}", prate, "sigs/s",
-                  base_rate)
+            from upow_tpu.benchutil import pipelined_loop
+
+            reps, elapsed = pipelined_loop(dispatch, check, seconds, depth)
+            _emit(f"verify_8k_pipelined_{_platform()}",
+                  reps * 8192 / elapsed, "sigs/s", base_rate)
         except Exception:
             import traceback
 
@@ -279,16 +273,21 @@ def config5_sharded(seconds: float):
     spec = sk.target_spec(job.previous_hash, "8.0")
     mesh = make_mesh()
     n_dev = len(mesh.devices.ravel())
-    per_dev = (1 << 26) if _platform() == "tpu" else (1 << 17)
+    per_dev = (1 << 28) if _platform() == "tpu" else (1 << 17)
     _ = int(pow_search_sharded(template, spec, 0, per_dev, mesh))
-    t0 = time.perf_counter()
-    hashes = 0
-    base = 0
-    while time.perf_counter() - t0 < seconds:
-        _ = int(pow_search_sharded(template, spec, base, per_dev, mesh))
-        hashes += per_dev * n_dev
-        base = (base + per_dev * n_dev) % (1 << 32)
-    rate = hashes / (time.perf_counter() - t0) / 1e6
+    # pipelined like the production mining loop (engine.mine, bench.py):
+    # two rounds in flight hide the host<->device sync round trip
+    from upow_tpu.benchutil import pipelined_loop
+
+    base = [0]
+
+    def dispatch():
+        r = pow_search_sharded(template, spec, base[0], per_dev, mesh)
+        base[0] = (base[0] + per_dev * n_dev) % (1 << 32)
+        return r
+
+    rounds, elapsed = pipelined_loop(dispatch, lambda r: int(r), seconds)
+    rate = rounds * per_dev * n_dev / elapsed / 1e6
     base_rate = _python_loop_mhs(job.prefix)
     _emit(f"mine_d8_sharded_{n_dev}x_{_platform()}", rate, "MH/s", base_rate)
 

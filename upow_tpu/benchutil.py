@@ -79,3 +79,26 @@ def python_loop_mhs(prefix: bytes, seconds: float = 1.0) -> float:
             hashlib.sha256(prefix + n.to_bytes(4, "little")).hexdigest()
             n += 1
     return n / (time.perf_counter() - t0) / 1e6
+
+
+def pipelined_loop(dispatch, finalize, seconds: float, depth: int = 2):
+    """Keep up to ``depth`` async dispatches in flight until the deadline,
+    then drain.  Returns (completed_rounds, elapsed) — elapsed includes
+    the drain, so rate accounting stays honest.
+
+    The canonical deadline/drain loop for device benchmarks (the mining
+    engine pipelines the same way): JAX dispatch is async, so the host
+    only blocks inside ``finalize`` on the oldest round while newer
+    rounds execute."""
+    t0 = time.perf_counter()
+    done = 0
+    inflight = []
+    while time.perf_counter() - t0 < seconds or inflight:
+        if len(inflight) < depth and time.perf_counter() - t0 < seconds:
+            inflight.append(dispatch())
+            continue
+        if not inflight:  # deadline crossed between the two time checks
+            break
+        finalize(inflight.pop(0))
+        done += 1
+    return done, time.perf_counter() - t0
