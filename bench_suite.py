@@ -273,6 +273,9 @@ def config5_sharded(seconds: float):
     spec = sk.target_spec(job.previous_hash, "8.0")
     mesh = make_mesh()
     n_dev = len(mesh.devices.ravel())
+    # 2^28/device matches bench.py's production round size (raised from
+    # 2^26 together with pipelining — TPU numbers from before that change
+    # are not comparable under this metric name)
     per_dev = (1 << 28) if _platform() == "tpu" else (1 << 17)
     _ = int(pow_search_sharded(template, spec, 0, per_dev, mesh))
     # pipelined like the production mining loop (engine.mine, bench.py):

@@ -831,3 +831,39 @@ def test_launcher_boots_from_config_alone(tmp_path):
         except subprocess.TimeoutExpired:
             proc.kill()
             raise AssertionError("node did not exit on SIGTERM")
+
+
+def test_metrics_endpoint(tmp_path, keys):
+    """Prometheus text exposition (beyond-reference observability): chain
+    height and mempool gauges move with the chain; span series appear
+    after a block accept."""
+
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        res = await mine_via_api(client, keys["addr"])
+        assert res.get("ok")
+
+        builder = WalletBuilder(node.state)
+        tx = await builder.create_transaction(
+            keys["d"], keys["addr2"], Decimal("0.25"))
+        resp = await client.post("/push_tx", json={"tx_hex": tx.hex()})
+        assert (await resp.json())["ok"]
+
+        resp = await client.get("/metrics")
+        assert resp.status == 200
+        assert resp.content_type == "text/plain"
+        body = await resp.text()
+        metrics = {}
+        for line in body.splitlines():
+            if line and not line.startswith("#"):
+                name, _, value = line.partition(" ")
+                metrics[name] = float(value)
+        assert metrics["upow_block_height"] == 1
+        assert metrics["upow_mempool_transactions"] == 1
+        assert metrics["upow_node_syncing"] == 0
+        assert "upow_ws_connections" in metrics
+        # the block accept above registered timing spans
+        assert any(k.startswith("upow_span_") and k.endswith("_count")
+                   and v >= 1 for k, v in metrics.items())
+
+    run_cluster(tmp_path, scenario)

@@ -335,6 +335,48 @@ class Node:
             "timings": stats(),
         })
 
+    async def h_metrics(self, request: web.Request) -> web.Response:
+        """Prometheus text exposition — beyond-reference observability
+        (SURVEY §5 notes the reference has "No Prometheus/StatsD").
+        Gauges for chain/mempool/peer/WS state plus the span registry as
+        per-section count/total/max series."""
+        from ..trace import stats
+
+        lines = []
+
+        def gauge(name, value, help_text):
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {value}")
+
+        gauge("upow_block_height", await self.state.get_next_block_id() - 1,
+              "Height of the last accepted block")
+        gauge("upow_mempool_transactions",
+              await self.state.get_pending_transactions_count(),
+              "Transactions waiting in the mempool")
+        gauge("upow_peers_known", len(self.peers.all_nodes()),
+              "Peers in the peer book")
+        gauge("upow_peers_active", len(self.peers.recent_nodes()),
+              "Peers messaged within the activity window")
+        gauge("upow_node_syncing", int(bool(self.is_syncing)),
+              "1 while a chain sync is in progress")
+        if self.ws_hub is not None:
+            ws = self.ws_hub.get_stats()
+            gauge("upow_ws_connections", ws["total_connections"],
+                  "Open WebSocket push connections")
+            gauge("upow_ws_messages_out", ws["messages_out"],
+                  "WebSocket messages delivered")
+        for name, s in sorted(stats().items()):
+            safe = name.replace(".", "_").replace("-", "_")
+            lines.append(f"# TYPE upow_span_{safe}_count counter")
+            lines.append(f"upow_span_{safe}_count {s['count']}")
+            lines.append(f"# TYPE upow_span_{safe}_seconds_total counter")
+            lines.append(f"upow_span_{safe}_seconds_total {s['total_s']:.6f}")
+            lines.append(f"# TYPE upow_span_{safe}_seconds_max gauge")
+            lines.append(f"upow_span_{safe}_seconds_max {s['max_s']:.6f}")
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
     async def h_push_tx(self, request: web.Request) -> web.Response:
         if self.is_syncing:
             return web.json_response(
@@ -910,6 +952,7 @@ class Node:
             ("/get_blocks_details", self.h_get_blocks_details),
             ("/dobby_info", self.h_dobby_info),
             ("/get_supply_info", self.h_get_supply_info),
+            ("/metrics", self.h_metrics),
         ]:
             r.add_get(path, handler)
         if self.config.ws.enabled:
