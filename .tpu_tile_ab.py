@@ -25,9 +25,9 @@ msgs, sigs, pubs = msgs * k, sigs * k, pubs * k
 digests = [hashlib.sha256(m).digest() for m in msgs]
 inputs, *_meta = P._pack_device_inputs(digests, sigs, pubs, 8192)
 
-for tile in (1024, 2048, 4096):
+for tile, w in ((1024, 4), (2048, 4), (4096, 4), (1024, 5), (2048, 5)):
     try:
-        fn = lambda: P._prep_and_verify_pallas_jac(*inputs, tile=tile)
+        fn = lambda: P._prep_and_verify_pallas_jac(*inputs, tile=tile, w=w)
         ok, exc = fn()
         ok = np.asarray(ok)
         assert ok.all() and not np.asarray(exc).any()
@@ -38,7 +38,8 @@ for tile in (1024, 2048, 4096):
             jax.block_until_ready(fn())
             reps += 1
         dt = time.perf_counter() - t0
-        print(f"tile={tile}: {reps*8192/dt:,.0f} sigs/s "
+        print(f"tile={tile} w={w}: {reps*8192/dt:,.0f} sigs/s "
               f"({dt/reps*1e3:.1f} ms/batch)", flush=True)
     except Exception as e:
-        print(f"tile={tile}: FAILED {type(e).__name__}: {e}", flush=True)
+        print(f"tile={tile} w={w}: FAILED {type(e).__name__}: {e}",
+              flush=True)

@@ -16,6 +16,7 @@ differential against the host oracle.
 import random
 
 import numpy as np
+import pytest
 
 from upow_tpu.core import curve
 from upow_tpu.core.constants import CURVE_N, CURVE_P
@@ -193,7 +194,7 @@ def test_jac_qtable_matches_scalar_mults():
 
 # --- the ladder round logic (short crafted ladders, eager) -----------------
 
-def _run_ladder(d1_rows, d2_rows, Q, r_vals=None, rn_vals=None):
+def _run_ladder(d1_rows, d2_rows, Q, r_vals=None, rn_vals=None, w=4):
     """d1/d2: list of per-round digit lists; Q: affine pubkey point.
     Returns (ok, exc, expected_points) where expected is computed via the
     host oracle from the digit values."""
@@ -212,13 +213,13 @@ def _run_ladder(d1_rows, d2_rows, Q, r_vals=None, rn_vals=None):
         if rn_vals is None else np.asarray(rn_vals)
     valid = np.ones(n, dtype=bool)
     ok, exc = p256._jac_verify_eager(d1, d2, qx, qy, rm, rnm, rn_ok, valid,
-                                     n_rounds=n_rounds)
+                                     n_rounds=n_rounds, w=w)
     expected = []
     for j in range(n):
         u1 = u2 = 0
         for k in range(n_rounds):
-            u1 = u1 * 16 + int(d1[k, j])
-            u2 = u2 * 16 + int(d2[k, j])
+            u1 = (u1 << w) + int(d1[k, j])
+            u2 = (u2 << w) + int(d2[k, j])
         pt = curve.point_add(curve.point_mul(u1, curve.G),
                              curve.point_mul(u2, Q))
         expected.append(pt)
@@ -305,16 +306,17 @@ def test_ladder_rn_wraparound_acceptance():
     assert list(ok) == [True, False]
 
 
-def test_ladder_fuzz_random_digits_vs_oracle():
-    """Randomized 4-round ladders across many lanes: verdicts must match
-    the oracle point exactly, with zero spurious exception flags (the
-    digit space is tiny, so collisions would need acc ≡ pick mod n —
-    impossible below wraparound)."""
+@pytest.mark.parametrize("w", [4, 5])
+def test_ladder_fuzz_random_digits_vs_oracle(w):
+    """Randomized 4-round ladders across many lanes (both window sizes):
+    verdicts must match the oracle point exactly, with zero spurious
+    exception flags (the digit space is tiny, so collisions would need
+    acc ≡ pick mod n — impossible below wraparound)."""
     Q = _rand_pt()
     n, rounds = 24, 4
-    d1 = [[rng.randrange(16) for _ in range(n)] for _ in range(rounds)]
-    d2 = [[rng.randrange(16) for _ in range(n)] for _ in range(rounds)]
-    _, _, expected = _run_ladder(d1, d2, Q)
+    d1 = [[rng.randrange(1 << w) for _ in range(n)] for _ in range(rounds)]
+    d2 = [[rng.randrange(1 << w) for _ in range(n)] for _ in range(rounds)]
+    _, _, expected = _run_ladder(d1, d2, Q, w=w)
     r_vals = []
     for j, pt in enumerate(expected):
         if pt is None:
@@ -323,16 +325,18 @@ def test_ladder_fuzz_random_digits_vs_oracle():
             r_vals.append((pt[0] + 1) % CURVE_P)   # wrong x -> reject
         else:
             r_vals.append(pt[0])
-    ok, exc, _ = _run_ladder(d1, d2, Q, r_vals=r_vals)
+    ok, exc, _ = _run_ladder(d1, d2, Q, r_vals=r_vals, w=w)
     assert not exc.any()
     for j, pt in enumerate(expected):
         want = pt is not None and j % 3 != 0
         assert bool(ok[j]) == want, (j, pt)
 
 
-def test_full_ladder_real_signatures_eager():
+@pytest.mark.parametrize("w", [4, 5])
+def test_full_ladder_real_signatures_eager(w):
     """The eager twin at full 256-bit scale with real signature-derived
-    digits — the exact data shape the Pallas kernel sees on TPU."""
+    digits — the exact data shape the Pallas kernel sees on TPU — at
+    both window sizes."""
     import hashlib
 
     from upow_tpu.crypto import fp as _fp
@@ -352,23 +356,47 @@ def test_full_ladder_real_signatures_eager():
     u1s, u2s, rms, rnms, rn_oks = [], [], [], [], []
     for m, (r, s) in zip(msgs, sigs):
         z = int.from_bytes(hashlib.sha256(m).digest(), "big")
-        w = pow(s, -1, CURVE_N)
-        u1s.append(z * w % CURVE_N)
-        u2s.append(r * w % CURVE_N)
+        sw = pow(s, -1, CURVE_N)
+        u1s.append(z * sw % CURVE_N)
+        u2s.append(r * sw % CURVE_N)
         rms.append(fp.to_mont(r, _FS))
         rnms.append(fp.to_mont((r + CURVE_N) % CURVE_P, _FS))
         rn_oks.append(r + CURVE_N < CURVE_P)
-    d1 = p256._scalar_digits(u1s)
-    d2 = p256._scalar_digits(u2s)
+
+    rounds = p256._jac_rounds(w)
+
+    def digits(xs):
+        return np.asarray(
+            [[(x >> (w * (rounds - 1 - k))) & ((1 << w) - 1) for x in xs]
+             for k in range(rounds)], dtype=np.int32)
+
+    d1, d2 = digits(u1s), digits(u2s)
+    if w == 4:  # the device extractor must agree with the host split
+        limbs = _fp.ints_to_limbs(u1s)
+        assert np.array_equal(np.asarray(p256._digits_from_limbs(limbs, w)),
+                              d1)
     qx = _fp.ints_to_limbs([fp.to_mont(pk[0], _FS) for pk in pubs])
     qy = _fp.ints_to_limbs([fp.to_mont(pk[1], _FS) for pk in pubs])
     rm = _fp.ints_to_limbs(rms)
     rnm = _fp.ints_to_limbs(rnms)
     ok, exc = p256._jac_verify_eager(
         d1, d2, qx, qy, rm, rnm, np.asarray(rn_oks),
-        np.ones(len(msgs), dtype=bool))
+        np.ones(len(msgs), dtype=bool), w=w)
     assert not exc.any()
     assert list(ok) == want
+
+
+def test_digits_from_limbs_w5_matches_host():
+    """The static bit surgery at w=5 (uneven 52x5 split) against a plain
+    python digit split."""
+    xs = [rng.randrange(CURVE_N) for _ in range(10)] + [0, 1, CURVE_N - 1]
+    limbs = fp.ints_to_limbs(xs)
+    got = np.asarray(p256._digits_from_limbs(limbs, 5))
+    rounds = p256._jac_rounds(5)
+    want = np.asarray(
+        [[(x >> (5 * (rounds - 1 - k))) & 31 for x in xs]
+         for k in range(rounds)], dtype=np.int32)
+    assert np.array_equal(got, want)
 
 
 # --- wrapper fallback plumbing --------------------------------------------
@@ -393,7 +421,7 @@ def test_exception_lanes_fall_back_to_host_oracle(monkeypatch):
 
     calls = []
 
-    def fake_kernel(z, r, s, qx, qy, range_ok, rn_ok, tile):
+    def fake_kernel(z, r, s, qx, qy, range_ok, rn_ok, tile, w=4):
         n = z.shape[1]
         # kernel "flags" lanes 1 and 3 and returns garbage verdicts there
         ok = np.zeros(n, dtype=bool)

@@ -319,23 +319,24 @@ def _pack_words(xs, pad: int) -> np.ndarray:
     return np.pad(w, ((0, 0), (0, pad)), constant_values=0)
 
 
-def _digits_from_limbs(limbs) -> jnp.ndarray:
-    """(21, N) canonical 13-bit limbs -> (64, N) w=4 digits, MSB first.
-
-    Static bit surgery: nibble k spans at most two limbs."""
+def _digits_from_limbs(limbs, w: int = _WINDOW) -> jnp.ndarray:
+    """(21, N) canonical 13-bit limbs -> (rounds, N) w-bit digits, MSB
+    first.  Static bit surgery: a digit spans at most two limbs for any
+    w <= 13; the top digit of an uneven split reads zero high bits."""
     lb = fp.LIMB_BITS
+    mask = (1 << w) - 1
     rows = []
-    for k in range(_DIGITS):
-        j, off = divmod(_WINDOW * k, lb)
+    for k in range(_jac_rounds(w)):
+        j, off = divmod(w * k, lb)
         v = limbs[j] >> off
-        if off + _WINDOW > lb:
+        if off + w > lb and j + 1 < fp.NUM_LIMBS:
             v = v | (limbs[j + 1] << (lb - off))
-        rows.append(v & 0xF)
+        rows.append(v & mask)
     return jnp.stack(rows[::-1], axis=0)
 
 
-@jax.jit
-def _scalar_prep(z, r, s, qx, qy, range_ok, rn_ok):
+@functools.partial(jax.jit, static_argnames=("w",))
+def _scalar_prep(z, r, s, qx, qy, range_ok, rn_ok, w: int = _WINDOW):
     """Packed 256-bit scalars -> ladder inputs, all on device.
 
     z/r/s/qx/qy: (8, N) uint32 little-endian words of the digest int,
@@ -360,8 +361,8 @@ def _scalar_prep(z, r, s, qx, qy, range_ok, rn_ok):
     one = fp.const(1, n_lanes, 2)
     u1 = fp.canon(fp.mont_mul(fp.mont_mul(z_m, w_m, ns), one, ns), ns)
     u2 = fp.canon(fp.mont_mul(fp.mont_mul(r_mn, w_m, ns), one, ns), ns)
-    d1 = _digits_from_limbs(u1)
-    d2 = _digits_from_limbs(u2)
+    d1 = _digits_from_limbs(u1, w)
+    d2 = _digits_from_limbs(u2, w)
 
     # mod-p: Montgomery forms of qx, qy, r, (r+n) mod p + on-curve check
     r2p = fp.const(fs.r2_mod_p, n_lanes, fs.p)
@@ -842,22 +843,29 @@ def _jac_add(P1, P2, fs=_FS):
     return (X3, Y3, Z3), H
 
 
-def _g_affine_table() -> np.ndarray:
-    """(2, 16, 21) int32 — affine Montgomery (x, y) of [k]G, k = 1..15.
+def _jac_rounds(w: int) -> int:
+    """Ladder rounds for a w-bit window (ceil; the top digit of an
+    uneven split reads zero bits past 256 — limbs carry 273)."""
+    return -(-256 // w)
+
+
+@functools.lru_cache(maxsize=None)
+def _g_affine_table(w: int = _WINDOW) -> np.ndarray:
+    """(2, 2^w, 21) int32 — affine Montgomery (x, y) of [k]G, k >= 1.
 
     Entry 0 is a placeholder: zero digits select the accumulator before
     the pick is ever used."""
     from ..core import curve as host_curve
 
-    rows = np.zeros((2, 16, fp.NUM_LIMBS), dtype=np.int32)
-    for k in range(1, 16):
+    size = 1 << w
+    rows = np.zeros((2, size, fp.NUM_LIMBS), dtype=np.int32)
+    for k in range(1, size):
         x, y = host_curve.point_mul(k, (CURVE_GX, CURVE_GY))
         rows[0, k] = fp.int_to_limbs(fp.to_mont(x, _FS))
         rows[1, k] = fp.int_to_limbs(fp.to_mont(y, _FS))
     return rows
 
 
-_G_TABLE_AFF = _g_affine_table()
 
 
 def _jac_identity(like):
@@ -872,11 +880,11 @@ def _jac_lift_affine(x2, y2):
             fp.l_full(_ONE_M, x2.limbs[0], _JB))
 
 
-def _jac_qtable(qx, qy, fs=_FS):
-    """Entries [1..15] = [k]Q as Jacobian FL points (bound <= _JB).
+def _jac_qtable(qx, qy, fs=_FS, size: int = 16):
+    """Entries [1..size-1] = [k]Q as Jacobian FL points (bound <= _JB).
 
     Exception-free for on-curve Q: [k]Q = ±Q would need (k∓1)Q = identity
-    with k−1 < 15 ≪ n (prime group order).  Off-curve garbage (already
+    with k−1 < size ≪ n (prime group order).  Off-curve garbage (already
     doomed by the `valid` flag) may produce garbage entries — harmless,
     the verdict is masked and any spurious exception flag just routes the
     lane to the host oracle, which rejects it."""
@@ -884,19 +892,20 @@ def _jac_qtable(qx, qy, fs=_FS):
                      fp.l_wrap(qy.limbs, CURVE_P),
                      fp.l_full(_ONE_M, qx.limbs[0], CURVE_P)))
     entries = [e1, _jac_clamp(_jac_dbl(e1, fs))]
-    for _ in range(3, 16):
+    for _ in range(3, size):
         nxt, _h = _jac_madd(entries[-1], qx, qy, fs)
         entries.append(_jac_clamp(nxt))
     return entries
 
 
-def _jac_round(acc, started, exc, dg1, dg2, g_pick_fn, q_pick_fn, fs=_FS):
-    """One w=4 digit round: 4 doublings, G mixed add, Q general add —
+def _jac_round(acc, started, exc, dg1, dg2, g_pick_fn, q_pick_fn, fs=_FS,
+               w: int = _WINDOW):
+    """One w-bit digit round: w doublings, G mixed add, Q general add —
     with the structural identity selects and exception flagging described
     in the section comment.  ``started``/``exc`` are int32 masks of the
     limb shape; ``g_pick_fn(dg) -> (x2, y2)`` affine FLs, ``q_pick_fn(dg)
     -> Jacobian FL point``.  Returns (acc, started, exc)."""
-    for _ in range(_WINDOW):
+    for _ in range(w):
         acc = _jac_clamp(_jac_dbl(acc, fs))
 
     gx, gy = g_pick_fn(dg1)
@@ -948,7 +957,7 @@ def _jac_final(acc, started, r_m, rn_m, rn_ok, valid, fs=_FS):
 
 
 def _jac_verify_eager(d1, d2, qx, qy, r_m, rn_m, rn_ok, valid,
-                      n_rounds: int = _DIGITS):
+                      n_rounds: Optional[int] = None, w: int = _WINDOW):
     """Host twin of the Pallas Jacobian kernel, same round logic via the
     shared helpers — runs on plain numpy (no jit, no device) so tests can
     drive short crafted ladders cheaply.  d1/d2: (n_rounds, N) int32
@@ -958,9 +967,13 @@ def _jac_verify_eager(d1, d2, qx, qy, r_m, rn_m, rn_ok, valid,
         return fp.l_wrap([np.asarray(a[i]) for i in range(fp.NUM_LIMBS)],
                          bound)
 
+    if n_rounds is None:
+        n_rounds = _jac_rounds(w)
+    size = 1 << w
+    g_tab = _g_affine_table(w)
     qx_f, qy_f = to_fl(qx, CURVE_P), to_fl(qy, CURVE_P)
     n = d1.shape[1]
-    qtab = _jac_qtable(qx_f, qy_f)
+    qtab = _jac_qtable(qx_f, qy_f, size=size)
 
     def g_pick_fn(dg):
         out = []
@@ -968,8 +981,8 @@ def _jac_verify_eager(d1, d2, qx, qy, r_m, rn_m, rn_ok, valid,
             limbs = []
             for l in range(fp.NUM_LIMBS):
                 acc = np.zeros((n,), np.int32)
-                for k in range(1, 16):
-                    g = int(_G_TABLE_AFF[c, k, l])
+                for k in range(1, size):
+                    g = int(g_tab[c, k, l])
                     if g:
                         acc = acc + np.where(dg == k, g, 0)
                 limbs.append(acc)
@@ -982,7 +995,7 @@ def _jac_verify_eager(d1, d2, qx, qy, r_m, rn_m, rn_ok, valid,
             limbs = []
             for l in range(fp.NUM_LIMBS):
                 acc = np.zeros((n,), np.int32)
-                for k in range(1, 16):
+                for k in range(1, size):
                     acc = acc + np.where(dg == k, qtab[k - 1][c].limbs[l], 0)
                 limbs.append(acc)
             out.append(fp.l_wrap(limbs, _JB))
@@ -994,42 +1007,44 @@ def _jac_verify_eager(d1, d2, qx, qy, r_m, rn_m, rn_ok, valid,
     exc = np.zeros((n,), np.int32)
     for k in range(n_rounds):
         acc, started, exc = _jac_round(acc, started, exc, d1[k], d2[k],
-                                       g_pick_fn, q_pick_fn)
+                                       g_pick_fn, q_pick_fn, w=w)
     ok = _jac_final(acc, started, to_fl(r_m, CURVE_P), to_fl(rn_m, CURVE_P),
                     rn_ok, valid)
     return np.asarray(ok), np.asarray(exc != 0)
 
 
 def _ladder_kernel_jac(d1_ref, d2_ref, qx_ref, qy_ref, rm_ref, rnm_ref,
-                       flags_ref, out_ref, qtab_ref):
+                       flags_ref, out_ref, qtab_ref, *, w: int = _WINDOW):
     """Pallas limb-list Jacobian ladder.  Same structure as
     :func:`_ladder_kernel_list` but ~1.5x fewer Montgomery products per
     round; emits bit0 = verdict, bit1 = exception flag per lane."""
     fs = _FS
     S = qx_ref.shape[1]
     shape = (S, 128)
+    size = 1 << w
+    g_tab = _g_affine_table(w)
 
     def read_fl(ref, bound):
         return fp.l_wrap([ref[i] for i in range(fp.NUM_LIMBS)], bound)
 
     qx_f, qy_f = read_fl(qx_ref, CURVE_P), read_fl(qy_ref, CURVE_P)
 
-    # --- Q table (entries 1..15) into VMEM scratch -----------------------
-    entries = _jac_qtable(qx_f, qy_f, fs)
+    # --- Q table (entries 1..size-1) into VMEM scratch -------------------
+    entries = _jac_qtable(qx_f, qy_f, fs, size=size)
     for k, e in enumerate(entries):
         for c in range(3):
             for l in range(fp.NUM_LIMBS):
                 qtab_ref[k, c, l] = e[c].limbs[l]
 
     def g_pick_fn(dg):
-        masks = [(dg == k).astype(jnp.int32) for k in range(16)]
+        masks = [(dg == k).astype(jnp.int32) for k in range(size)]
         out = []
         for c in range(2):
             limbs = []
             for l in range(fp.NUM_LIMBS):
                 acc = None
-                for k in range(1, 16):
-                    g = int(_G_TABLE_AFF[c, k, l])
+                for k in range(1, size):
+                    g = int(g_tab[c, k, l])
                     if g == 0:
                         continue
                     term = masks[k] * g
@@ -1040,13 +1055,13 @@ def _ladder_kernel_jac(d1_ref, d2_ref, qx_ref, qy_ref, rm_ref, rnm_ref,
         return tuple(out)
 
     def q_pick_fn(dg):
-        masks = [(dg == k).astype(jnp.int32) for k in range(16)]
+        masks = [(dg == k).astype(jnp.int32) for k in range(size)]
         out = []
         for c in range(3):
             limbs = []
             for l in range(fp.NUM_LIMBS):
                 acc = masks[1] * qtab_ref[0, c, l]
-                for k in range(2, 16):
+                for k in range(2, size):
                     acc = acc + masks[k] * qtab_ref[k - 1, c, l]
                 limbs.append(acc)
             out.append(fp.l_wrap(limbs, _JB))
@@ -1060,12 +1075,13 @@ def _ladder_kernel_jac(d1_ref, d2_ref, qx_ref, qy_ref, rm_ref, rnm_ref,
         started, exc = carry[3], carry[4]
         acc, started, exc = _jac_round(acc, started, exc,
                                        d1_ref[k], d2_ref[k],
-                                       g_pick_fn, q_pick_fn, fs)
+                                       g_pick_fn, q_pick_fn, fs, w=w)
         return flatten(acc, started, exc)
 
     acc0 = _jac_identity(qx_f.limbs[0])
     z = jnp.zeros(shape, jnp.int32)
-    carry = jax.lax.fori_loop(0, _DIGITS, round_body, flatten(acc0, z, z))
+    carry = jax.lax.fori_loop(0, _jac_rounds(w), round_body,
+                              flatten(acc0, z, z))
     acc = tuple(fp.l_wrap(limbs, _JB) for limbs in carry[:3])
     started, exc = carry[3], carry[4]
 
@@ -1076,9 +1092,10 @@ def _ladder_kernel_jac(d1_ref, d2_ref, qx_ref, qy_ref, rm_ref, rnm_ref,
     out_ref[...] = ok.astype(jnp.int32) + 2 * exc
 
 
-@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+@functools.partial(jax.jit, static_argnames=("tile", "interpret", "w"))
 def _verify_device_pallas_jac(d1, d2, qx, qy, r_m, rn_m, flags,
-                              tile: int = 1024, interpret: bool = False):
+                              tile: int = 1024, interpret: bool = False,
+                              w: int = _WINDOW):
     """Run the Jacobian ladder kernel; returns (ok, exc) bool (N,) arrays."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -1094,10 +1111,10 @@ def _verify_device_pallas_jac(d1, d2, qx, qy, r_m, rn_m, flags,
     spec = lambda r: pl.BlockSpec(
         (r, sub, 128), lambda i: (0, i, 0), memory_space=pltpu.VMEM)
     out = pl.pallas_call(
-        _ladder_kernel_jac,
+        functools.partial(_ladder_kernel_jac, w=w),
         grid=(grid,),
         in_specs=[
-            spec(_DIGITS), spec(_DIGITS),
+            spec(_jac_rounds(w)), spec(_jac_rounds(w)),
             spec(fp.NUM_LIMBS), spec(fp.NUM_LIMBS),
             spec(fp.NUM_LIMBS), spec(fp.NUM_LIMBS),
             spec(2),
@@ -1106,7 +1123,8 @@ def _verify_device_pallas_jac(d1, d2, qx, qy, r_m, rn_m, flags,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.int32),
         scratch_shapes=[
-            pltpu.VMEM((15, 3, fp.NUM_LIMBS, sub, 128), jnp.int32)],
+            pltpu.VMEM(((1 << w) - 1, 3, fp.NUM_LIMBS, sub, 128),
+                       jnp.int32)],
         interpret=interpret,
     )(rs(d1), rs(d2), rs(qx), rs(qy), rs(r_m), rs(rn_m), rs(flags))
     out = out.reshape(n)
@@ -1139,6 +1157,11 @@ PALLAS_STRICT = False  # True: never fall back (tests assert kernel health)
 # host-prep pallas branch always runs the RCB16 kernels (it exists for
 # the interpret-mode kernel test, which targets them explicitly).
 PALLAS_KERNEL = "jac"
+# Jacobian ladder window bits.  w=4: 64 rounds, 16-entry tables.  w=5:
+# 52 rounds (fewer adds/tests per bit) but 32-entry tables (pricier
+# picks/setup) — measured A/B on the chip decides; both are covered by
+# the eager-twin differentials.
+PALLAS_JAC_WINDOW = 4
 
 
 def _pallas_or_jnp(pallas_thunk, jnp_thunk) -> np.ndarray:
@@ -1202,17 +1225,18 @@ def _prep_and_verify_pallas(z, r, s, qx, qy, range_ok, rn_ok, tile: int):
     return _verify_device_pallas(*args, tile=tile)
 
 
-@functools.partial(jax.jit, static_argnames=("tile",))
-def _prep_and_verify_pallas_jac(z, r, s, qx, qy, range_ok, rn_ok, tile: int):
+@functools.partial(jax.jit, static_argnames=("tile", "w"))
+def _prep_and_verify_pallas_jac(z, r, s, qx, qy, range_ok, rn_ok, tile: int,
+                                w: int = _WINDOW):
     """One dispatch: device scalar prep -> Jacobian ladder kernel.
     Returns (ok, exc) — exception-flagged lanes need the host oracle."""
-    args = _scalar_prep(z, r, s, qx, qy, range_ok, rn_ok)
-    return _verify_device_pallas_jac(*args, tile=tile)
+    args = _scalar_prep(z, r, s, qx, qy, range_ok, rn_ok, w=w)
+    return _verify_device_pallas_jac(*args, tile=tile, w=w)
 
 
-@functools.partial(jax.jit, static_argnames=("tile", "mesh"))
+@functools.partial(jax.jit, static_argnames=("tile", "mesh", "w"))
 def _prep_and_verify_pallas_jac_sharded(z, r, s, qx, qy, range_ok, rn_ok,
-                                        tile: int, mesh):
+                                        tile: int, mesh, w: int = _WINDOW):
     """Mesh-DP variant: every device runs scalar prep + the Pallas ladder
     on its own batch shard (the program is elementwise over lanes, so the
     only communication is the output gather).  ``shard_map`` is required
@@ -1225,8 +1249,8 @@ def _prep_and_verify_pallas_jac_sharded(z, r, s, qx, qy, range_ok, rn_ok,
     shard_map, check_kw = shard_map_compat()
 
     def per_device(z_, r_, s_, qx_, qy_, range_ok_, rn_ok_):
-        args = _scalar_prep(z_, r_, s_, qx_, qy_, range_ok_, rn_ok_)
-        return _verify_device_pallas_jac(*args, tile=tile)
+        args = _scalar_prep(z_, r_, s_, qx_, qy_, range_ok_, rn_ok_, w=w)
+        return _verify_device_pallas_jac(*args, tile=tile, w=w)
 
     lanes = P(None, "dp")
     flat = P("dp")
@@ -1358,10 +1382,11 @@ def verify_batch_prehashed(
                     ok, exc = _prep_and_verify_pallas_jac_sharded(
                         *inputs,
                         tile=_pick_tile(padded // mesh.devices.size),
-                        mesh=mesh)
+                        mesh=mesh, w=PALLAS_JAC_WINDOW)
                 else:
                     ok, exc = _prep_and_verify_pallas_jac(
-                        *inputs, tile=_pick_tile(padded))
+                        *inputs, tile=_pick_tile(padded),
+                        w=PALLAS_JAC_WINDOW)
                 return np.stack([np.asarray(ok), np.asarray(exc)])
 
             def jnp_thunk():
