@@ -19,7 +19,7 @@ import hashlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..core.codecs import OutputType, TransactionType, string_to_point
-from ..core.constants import SMALLEST
+from ..core.constants import MAX_INODES, SMALLEST
 from ..core.tx import CoinbaseTx, Tx
 from ..state.storage import ChainState, _INPUT_TABLE
 
@@ -354,7 +354,7 @@ class TxVerifier:
             return False
         if await self.state.is_validator_registered(address, check_pending_txs=True):
             return False
-        if len(await self.state.get_active_inodes(check_pending_txs=True)) >= 12:
+        if len(await self.state.get_active_inodes(check_pending_txs=True)) >= MAX_INODES:
             return False
         active = await self.state.get_active_inodes()
         if any(e["wallet"] == address for e in active):
