@@ -27,10 +27,9 @@ inputs, *_meta = P._pack_device_inputs(digests, sigs, pubs, 8192)
 
 for tile, w in ((1024, 4), (2048, 4), (4096, 4), (1024, 5), (2048, 5)):
     try:
-        fn = lambda: P._prep_and_verify_pallas_jac(*inputs, tile=tile, w=w)
-        ok, exc = fn()
-        ok = np.asarray(ok)
-        assert ok.all() and not np.asarray(exc).any()
+        fn = lambda: P._prep_and_verify_pallas_jac(inputs, tile=tile, w=w)
+        res = np.asarray(fn())
+        assert res[0].all() and not res[1].any()
         jax.block_until_ready(fn())
         t0 = time.perf_counter()
         reps = 0

@@ -183,11 +183,11 @@ def config3_batch_verify(seconds: float):
 
         def dispatch():
             inputs, *_meta = P._pack_device_inputs(digests, sigs, pubs, 8192)
-            return P._prep_and_verify_pallas_jac(*inputs, tile=tile)
+            return P._prep_and_verify_pallas_jac(inputs, tile=tile)
 
         def check(res):
-            ok, exc = (np.asarray(a) for a in res)
-            assert bool(ok.all()) and not bool(exc.any())
+            res = np.asarray(res)
+            assert bool(res[0].all()) and not bool(res[1].any())
 
         try:
             jax.block_until_ready(dispatch())  # warm

@@ -433,7 +433,8 @@ def test_device_prep_input_sanitation_fast():
 
     captured = {}
 
-    def stub(z, r, s, qx, qy, range_ok, rn_ok):
+    def stub(packed):
+        z, r, s, qx, qy, range_ok, rn_ok = p256._unpack_fused(packed)
         captured.update(z=np.asarray(z), r=np.asarray(r), s=np.asarray(s),
                         qx=np.asarray(qx), qy=np.asarray(qy),
                         range_ok=np.asarray(range_ok))

@@ -421,15 +421,15 @@ def test_exception_lanes_fall_back_to_host_oracle(monkeypatch):
 
     calls = []
 
-    def fake_kernel(z, r, s, qx, qy, range_ok, rn_ok, tile, w=4):
-        n = z.shape[1]
+    def fake_kernel(packed, tile, w=4):
+        n = packed.shape[1]
         # kernel "flags" lanes 1 and 3 and returns garbage verdicts there
         ok = np.zeros(n, dtype=bool)
         exc = np.zeros(n, dtype=bool)
         ok[0], ok[2], ok[4] = want[0], want[2], want[4]
         ok[1] = not want[1]
         exc[1], exc[3] = True, True
-        return ok, exc
+        return np.stack([ok, exc])
 
     real_host = p256._host_verify_prehashed
 
