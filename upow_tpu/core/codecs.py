@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import hashlib
 from enum import Enum, IntEnum
+from functools import lru_cache
 from math import ceil
 from typing import Tuple, Union
 
@@ -165,7 +166,13 @@ def point_to_bytes(point: Point, address_format: AddressFormat = AddressFormat.F
     raise NotImplementedError()
 
 
+@lru_cache(maxsize=65536)
 def bytes_to_point(point_bytes: bytes) -> Point:
+    """Decode (and for 33-byte form decompress) an address to its curve
+    point.  Cached: block verification decodes the same addresses over
+    and over (a few decompressions per tx, ~130 µs each in sqrt-mod-p),
+    and real chains reuse addresses heavily.  Invalid inputs raise and
+    are NOT cached (lru_cache does not memoize exceptions)."""
     if len(point_bytes) == 64:
         x = int.from_bytes(point_bytes[:32], ENDIAN)
         y = int.from_bytes(point_bytes[32:], ENDIAN)
@@ -189,8 +196,11 @@ def point_to_string(point: Point, address_format: AddressFormat = AddressFormat.
     raise NotImplementedError()
 
 
+@lru_cache(maxsize=65536)
 def string_to_bytes(string: str) -> bytes:
-    """Address string to bytes: hex first, base58 fallback (helpers.py:183-188)."""
+    """Address string to bytes: hex first, base58 fallback (helpers.py:183-188).
+    Cached alongside :func:`bytes_to_point` — the pure-python base58
+    decode is a per-address cost the verify path pays repeatedly."""
     try:
         return bytes.fromhex(string)
     except ValueError:

@@ -56,12 +56,53 @@ def point_mul(k: int, p: Point) -> Point:
     return result
 
 
+_G_WINDOW = 8  # fixed-base table: 32 windows x 256 entries, built lazily
+_G_TABLE: Optional[list] = None
+
+
+def _g_table() -> list:
+    """T[i][j] = j * 2^(8i) * G for j in 1..255 (index j-1).  One-time
+    ~0.2 s build; every subsequent k*G costs <=31 point adds instead of
+    the ~384 add/double ops of the generic ladder — signing is the
+    wallet's per-tx hot loop (reference delegates it to fastecdsa's C)."""
+    global _G_TABLE
+    if _G_TABLE is None:
+        table = []
+        base: Point = G
+        for _ in range(256 // _G_WINDOW):
+            row = [base]
+            for _ in range(254):
+                row.append(point_add(row[-1], base))
+            table.append(row)
+            nxt = row[-1]  # 255 * 2^(8i) * G
+            base = point_add(nxt, base)  # 2^(8(i+1)) * G
+        _G_TABLE = table
+    return _G_TABLE
+
+
+def point_mul_G(k: int) -> Point:
+    """k * G via the fixed-base window table (same result as
+    ``point_mul(k, G)``)."""
+    if k % CURVE_N == 0:
+        return None
+    table = _g_table()
+    result: Point = None
+    i = 0
+    while k:
+        d = k & 0xFF
+        if d:
+            result = point_add(result, table[i][d - 1])
+        k >>= 8
+        i += 1
+    return result
+
+
 def keygen(rng: Optional[int] = None) -> Tuple[int, Tuple[int, int]]:
     """Return (private_key, public_point)."""
     d = (rng if rng is not None else secrets.randbelow(CURVE_N - 1)) % CURVE_N
     if d == 0:
         d = 1
-    pub = point_mul(d, G)
+    pub = point_mul_G(d)
     assert pub is not None
     return d, pub
 
@@ -105,7 +146,7 @@ def sign(message: bytes, d: int) -> Tuple[int, int]:
     z = _bits2int(msg_hash)
     while True:
         k = _rfc6979_k(msg_hash, d)
-        p = point_mul(k, G)
+        p = point_mul_G(k)
         assert p is not None
         r = p[0] % CURVE_N
         if r == 0:
@@ -125,7 +166,7 @@ def verify(signature: Tuple[int, int], message: bytes, pub: Tuple[int, int]) -> 
     w = _inv(s, CURVE_N)
     u1 = z * w % CURVE_N
     u2 = r * w % CURVE_N
-    p = point_add(point_mul(u1, G), point_mul(u2, pub))
+    p = point_add(point_mul_G(u1), point_mul(u2, pub))
     if p is None:
         return False
     return p[0] % CURVE_N == r % CURVE_N

@@ -230,7 +230,13 @@ class ChainState:
 
     @asynccontextmanager
     async def atomic(self):
-        """One transaction around a whole block acceptance."""
+        """One transaction around a whole block acceptance.  While it is
+        open, the per-method ``_commit()`` calls inside are no-ops — a
+        partial block must never become durable (an inner commit would
+        make atomic()'s rollback silently keep the committed half:
+        accepted block + mempool removals with the spent UTXOs still
+        unspent)."""
+        self._in_atomic = True
         try:
             self.db.execute("BEGIN")
             yield
@@ -239,6 +245,12 @@ class ChainState:
             self.db.rollback()
             self._index_rebuild()  # undo any index updates the txn made
             raise
+        finally:
+            self._in_atomic = False
+
+    def _commit(self) -> None:
+        if not getattr(self, "_in_atomic", False):
+            self.db.commit()
 
     # ------------------------------------------------------------- blocks --
 
@@ -333,7 +345,7 @@ class ChainState:
             "DELETE FROM transactions WHERE tx_hash = ?", [(h,) for h in created]
         )
         self.db.execute("DELETE FROM blocks WHERE id >= ?", (from_block_id,))
-        self.db.commit()
+        self._commit()
         self._index_rebuild()  # reorgs are rare; a bulk resync is ms
 
     async def _restore_spent_outputs(self, inputs: List[TxInput]) -> None:
@@ -493,7 +505,7 @@ class ChainState:
             "INSERT INTO pending_spent_outputs (tx_hash, idx) VALUES (?,?)",
             [(i.tx_hash, i.index) for i in tx.inputs],
         )
-        self.db.commit()
+        self._commit()
         self._pending_gen += 1
 
     async def pending_transaction_exists(self, tx_hash: str) -> bool:
@@ -538,22 +550,37 @@ class ChainState:
         return {(r["tx_hash"], r["idx"]) for r in rows}
 
     async def remove_pending_transactions_by_hash(self, hashes: List[str]) -> None:
-        for h in hashes:
-            tx = await self.get_transaction(h, include_pending=True)
-            if tx is not None and not tx.is_coinbase:
+        """Batched (8k-tx block profile): the spent-output overlay rows
+        only ever exist alongside a live pending_transactions row (see
+        add_pending_transaction), so one SELECT per chunk over the
+        pending table finds every tx whose overlay needs cleanup — no
+        per-hash lookup, no re-parsing just-accepted txs out of the
+        transactions table."""
+        for i in range(0, len(hashes), 500):
+            chunk = hashes[i:i + 500]
+            ph = ",".join("?" * len(chunk))
+            rows = self.db.execute(
+                "SELECT tx_hex FROM pending_transactions"
+                f" WHERE tx_hash IN ({ph})", chunk).fetchall()
+            spent = []
+            for r in rows:
+                tx = tx_from_hex(r["tx_hex"], check_signatures=False)
+                if not tx.is_coinbase:
+                    spent.extend((inp.tx_hash, inp.index) for inp in tx.inputs)
+            if spent:
                 self.db.executemany(
-                    "DELETE FROM pending_spent_outputs WHERE tx_hash = ? AND idx = ?",
-                    [(i.tx_hash, i.index) for i in tx.inputs],
-                )
+                    "DELETE FROM pending_spent_outputs"
+                    " WHERE tx_hash = ? AND idx = ?", spent)
             self.db.execute(
-                "DELETE FROM pending_transactions WHERE tx_hash = ?", (h,))
-        self.db.commit()
+                f"DELETE FROM pending_transactions WHERE tx_hash IN ({ph})",
+                chunk)
+        self._commit()
         self._pending_gen += 1
 
     async def remove_pending_transactions(self) -> None:
         self.db.execute("DELETE FROM pending_transactions")
         self.db.execute("DELETE FROM pending_spent_outputs")
-        self.db.commit()
+        self._commit()
         self._pending_gen += 1
 
     async def get_pending_transactions_count(self) -> int:
@@ -573,7 +600,7 @@ class ChainState:
             "UPDATE pending_transactions SET propagation_time = ? WHERE tx_hash = ?",
             (now_ts(), tx_hash),
         )
-        self.db.commit()
+        self._commit()
 
     # --------------------------------------------------------------- UTXO --
 
@@ -1243,7 +1270,7 @@ class ChainState:
         for tx in txs:
             await self.add_transaction_outputs([tx])
             await self.remove_outputs([tx])
-        self.db.commit()
+        self._commit()
         self._index_rebuild()  # replay rewrote the tables wholesale
 
     # ----------------------------------------------------------- emission --
