@@ -1,0 +1,23 @@
+#!/bin/bash
+# On-chip measurement queue: waits for the tunneled TPU to probe healthy,
+# then runs the pending A/Bs serially (the chip claim is exclusive per
+# process).  Results land in /tmp/tpuq/.
+set -u
+mkdir -p /tmp/tpuq
+cd /root/repo
+for i in $(seq 1 72); do
+  if timeout 100 python -c 'import jax; jax.devices()' >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) tunnel healthy, running queue" >> /tmp/tpuq/log
+    timeout 3000 python -u .tpu_tile_ab.py > /tmp/tpuq/ab.out 2>/tmp/tpuq/ab.err
+    echo "$(date -u +%H:%M:%S) ab done rc=$?" >> /tmp/tpuq/log
+    timeout 1200 python bench_suite.py --configs 3 --seconds 10 > /tmp/tpuq/c3.out 2>/tmp/tpuq/c3.err
+    echo "$(date -u +%H:%M:%S) c3 done rc=$?" >> /tmp/tpuq/log
+    timeout 900 python bench.py > /tmp/tpuq/bench.out 2>/tmp/tpuq/bench.err
+    echo "$(date -u +%H:%M:%S) bench done rc=$?" >> /tmp/tpuq/log
+    exit 0
+  fi
+  echo "$(date -u +%H:%M:%S) tunnel down (probe $i)" >> /tmp/tpuq/log
+  sleep 290
+done
+echo "gave up after 6h" >> /tmp/tpuq/log
+exit 1

@@ -38,3 +38,16 @@ compile_cache.enable(
     os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"))
 
 sys.path.insert(0, os.path.dirname(__file__))  # for `import ref_loader`
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sig_verdicts():
+    """The process-level signature-verdict cache must not leak verdicts
+    across tests (a test asserting a backend runs would silently pass on
+    another test's cache hits)."""
+    from upow_tpu.verify import txverify
+
+    txverify.clear_sig_verdicts()
+    yield

@@ -355,6 +355,46 @@ def test_mempool_intake_and_gc(keys):
     run(scenario())
 
 
+def test_sig_verdict_cache_skips_reverify_at_accept(keys):
+    """A tx verified at mempool intake must not pay signature
+    verification again when its block is accepted (the reference
+    re-verifies every gossiped tx twice: push_tx then check_block).
+    Proven by breaking every verification backend after intake — the
+    accept must still succeed purely from the verdict cache."""
+    async def scenario():
+        state = ChainState()
+        manager = BlockManager(state, sig_backend="host")
+        await mine_and_accept(manager, state, keys["a1"], ts_offset=-3)
+
+        tx = await make_send(state, keys["d1"], keys["a1"], keys["a2"],
+                             1 * SMALLEST)
+        verifier = TxVerifier(state)
+        assert await verifier.verify_pending(tx, sig_backend="host")
+        await state.add_pending_transaction(tx)
+
+        from upow_tpu.verify import txverify as tv
+
+        def no_backend(*a, **k):
+            raise AssertionError("signature re-verified despite cache")
+
+        orig_host, orig_native = tv._host_verify_digest, None
+        from upow_tpu import native as native_mod
+
+        orig_native = native_mod.p256_verify_batch
+        tv._host_verify_digest = no_backend
+        native_mod.p256_verify_batch = no_backend
+        try:
+            await mine_and_accept(manager, state, keys["a1"], txs=[tx],
+                                  ts_offset=-1)
+        finally:
+            tv._host_verify_digest = orig_host
+            native_mod.p256_verify_batch = orig_native
+        assert await state.get_transaction(tx.hash()) is not None
+        state.close()
+
+    run(scenario())
+
+
 def test_atomic_rollback_spans_inner_commits(keys):
     """A failure on the LAST write inside the block-accept transaction
     must roll back every earlier write — including methods like

@@ -177,13 +177,18 @@ def test_sig_checks_survive_hung_device(monkeypatch):
                                   device_timeout=1.5)
     assert _time.monotonic() - t0 < 30
     assert txverify._DEVICE_POISONED
-    want = txverify.run_sig_checks(checks, backend="host")
+    # use_cache=False throughout: each assertion below claims a specific
+    # BACKEND ROUTING behavior — a verdict-cache hit would satisfy the
+    # equality without exercising the routing at all
+    want = txverify.run_sig_checks(checks, backend="host", use_cache=False)
     assert out == want
     # and auto now routes straight to host
-    assert txverify.run_sig_checks(checks, backend="auto") == want
+    assert txverify.run_sig_checks(checks, backend="auto",
+                                   use_cache=False) == want
     # an explicitly configured device backend honors the poison flag too
     # (no 240 s re-pay per block): instant, correct verdicts
     t1 = _time.monotonic()
     assert txverify.run_sig_checks(checks, backend="device",
-                                   device_timeout=120.0) == want
+                                   device_timeout=120.0,
+                                   use_cache=False) == want
     assert _time.monotonic() - t1 < 10

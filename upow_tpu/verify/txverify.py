@@ -16,6 +16,8 @@ string (transaction_input.py:100-109 tries both), and the per-tx
 from __future__ import annotations
 
 import hashlib
+import threading
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..core.codecs import OutputType, TransactionType, string_to_point
@@ -118,9 +120,22 @@ async def run_sig_checks_async(checks: Sequence[tuple],
                                 device_timeout=device_timeout))
 
 
+_SIG_VERDICTS: "OrderedDict[tuple, bool]" = OrderedDict()
+_SIG_VERDICTS_MAX = 1 << 16
+_SIG_VERDICTS_LOCK = threading.Lock()  # intake + block verify run on
+# different executor threads; OrderedDict mutation is not atomic
+
+
+def clear_sig_verdicts() -> None:
+    """Drop the process-level signature-verdict cache (tests)."""
+    with _SIG_VERDICTS_LOCK:
+        _SIG_VERDICTS.clear()
+
+
 def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
                    pad_block: int = 128,
-                   device_timeout: float = 240.0) -> List[bool]:
+                   device_timeout: float = 240.0,
+                   use_cache: bool = True) -> List[bool]:
     """Verify deferred checks in one (or two) batched device calls.
 
     Pass 1 verifies against the raw-bytes digest; only failures re-try the
@@ -134,9 +149,39 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
     :func:`_device_usable` — the probe survives a hung TPU tunnel), and
     the host batch otherwise (small batches always stay host-side:
     dispatch overhead dominates under ~8 signatures).
+
+    Verdicts are memoized process-wide (bounded LRU) keyed on the full
+    (digest, hexdigest, signature, pubkey) tuple: ECDSA verification is
+    pure, so a tx verified at mempool intake is NOT re-verified when its
+    block is accepted — the reference pays that double verification
+    (push_tx intake then check_block, transaction.py:185-238) on every
+    gossiped tx.  Reorgs and sync re-accepts hit the same cache.
     """
     if not checks:
         return []
+    if use_cache:
+        out: List[Optional[bool]] = [None] * len(checks)
+        misses = []
+        with _SIG_VERDICTS_LOCK:
+            for i, c in enumerate(checks):
+                v = _SIG_VERDICTS.get(c)
+                if v is None:
+                    misses.append(i)
+                else:
+                    _SIG_VERDICTS.move_to_end(c)
+                    out[i] = v
+        if misses:
+            fresh = run_sig_checks(
+                [checks[i] for i in misses], backend=backend,
+                pad_block=pad_block, device_timeout=device_timeout,
+                use_cache=False)
+            with _SIG_VERDICTS_LOCK:
+                for i, v in zip(misses, fresh):
+                    out[i] = v
+                    _SIG_VERDICTS[checks[i]] = v
+                while len(_SIG_VERDICTS) > _SIG_VERDICTS_MAX:
+                    _SIG_VERDICTS.popitem(last=False)
+        return out  # type: ignore[return-value]
     if backend == "auto":
         if len(checks) < 8:
             backend = "host"
@@ -212,7 +257,8 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
             [c[0] for c in checks], [c[2] for c in checks],
             [c[3] for c in checks])
     except Exception:
-        return run_sig_checks(checks, backend="host", pad_block=pad_block)
+        return run_sig_checks(checks, backend="host", pad_block=pad_block,
+                              device_timeout=device_timeout, use_cache=False)
     out = list(map(bool, first))
     retry = [i for i, ok in enumerate(out) if not ok]
     if retry:
