@@ -355,7 +355,7 @@ def test_mempool_intake_and_gc(keys):
     run(scenario())
 
 
-def test_sig_verdict_cache_skips_reverify_at_accept(keys):
+def test_sig_verdict_cache_skips_reverify_at_accept(keys, monkeypatch):
     """A tx verified at mempool intake must not pay signature
     verification again when its block is accepted (the reference
     re-verifies every gossiped tx twice: push_tx then check_block).
@@ -372,23 +372,17 @@ def test_sig_verdict_cache_skips_reverify_at_accept(keys):
         assert await verifier.verify_pending(tx, sig_backend="host")
         await state.add_pending_transaction(tx)
 
+        from upow_tpu import native as native_mod
         from upow_tpu.verify import txverify as tv
 
         def no_backend(*a, **k):
             raise AssertionError("signature re-verified despite cache")
 
-        orig_host, orig_native = tv._host_verify_digest, None
-        from upow_tpu import native as native_mod
-
-        orig_native = native_mod.p256_verify_batch
-        tv._host_verify_digest = no_backend
-        native_mod.p256_verify_batch = no_backend
-        try:
-            await mine_and_accept(manager, state, keys["a1"], txs=[tx],
-                                  ts_offset=-1)
-        finally:
-            tv._host_verify_digest = orig_host
-            native_mod.p256_verify_batch = orig_native
+        monkeypatch.setattr(tv, "_host_verify_digest", no_backend)
+        monkeypatch.setattr(native_mod, "p256_verify_batch", no_backend)
+        await mine_and_accept(manager, state, keys["a1"], txs=[tx],
+                              ts_offset=-1)
+        monkeypatch.undo()
         assert await state.get_transaction(tx.hash()) is not None
         state.close()
 

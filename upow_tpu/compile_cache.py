@@ -35,6 +35,17 @@ def host_fingerprint() -> str:
     return hashlib.sha256("|".join(bits).encode()).hexdigest()[:12]
 
 
+def evict_host_dir(cache_root: str) -> None:
+    """Delete this host's cache subdir (the layout twin of
+    :func:`enable`) — for recovery when a cached AOT entry miscomputes
+    or hangs (e.g. CPU features changed under the same fingerprint
+    after a VM migration)."""
+    import shutil
+
+    shutil.rmtree(os.path.join(cache_root, host_fingerprint()),
+                  ignore_errors=True)
+
+
 def enable(cache_root: str) -> str:
     """Point JAX's persistent compile cache at a per-host subdir of
     ``cache_root``.  Never raises; returns the directory used ('' on

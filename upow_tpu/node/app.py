@@ -238,6 +238,11 @@ class Node:
                 self.peers.add(url)
             self.peers.remove(self.self_url)
             await self.propagate("add_node", {"url": self.self_url})
+            # catch up immediately after (re)start instead of waiting for
+            # a push_block to reveal the gap (the reference only syncs on
+            # gap detection, main.py:551-579 — a restarted node there
+            # serves a stale chain until the next block arrives)
+            self._spawn(self.sync_blockchain())
         except Exception as e:
             log.debug("bootstrap failed: %s", e)
 
@@ -801,13 +806,35 @@ class Node:
 
     # ------------------------------------------------------------ sync ----
     async def sync_blockchain(self, node_url: Optional[str] = None):
-        """Guarded wrapper (main.py:230-243)."""
+        """Guarded wrapper (main.py:230-243).  When no peer is named, up
+        to 3 distinct peers are tried before giving up — the reference
+        picks ONE random peer per call (main.py:158-166), so a single
+        dead seed (or its own unreachable CORE_URL default) makes that
+        sync attempt a no-op even with healthy peers in the book."""
         if self.is_syncing:
             return "Node is already syncing"
         self.is_syncing = True
         self.manager.is_syncing = True
         try:
-            return await self._sync_blockchain(node_url)
+            if node_url:
+                return await self._sync_blockchain(node_url)
+            nodes = self.peers.recent_nodes()
+            if not nodes:
+                return "No nodes found."
+            result = None
+            for url in random.sample(nodes, min(3, len(nodes))):
+                try:
+                    result = await self._sync_blockchain(url)
+                except Exception as e:
+                    # a dead peer raises from the fork-detection fetches
+                    # before the paged loop's own error handling — it
+                    # must advance the retry, not abort it
+                    result = e
+                if result is True:
+                    return True
+                log.info("sync from %s did not complete (%s); trying "
+                         "another peer", url, result)
+            return result
         except Exception as e:
             log.error("sync_blockchain error: %s", e)
             return e
@@ -815,14 +842,10 @@ class Node:
             self.is_syncing = False
             self.manager.is_syncing = False
 
-    async def _sync_blockchain(self, node_url: Optional[str] = None):
-        """Fork detection + paged download (main.py:153-227)."""
+    async def _sync_blockchain(self, node_url: str):
+        """Fork detection + paged download (main.py:153-227), against one
+        named peer."""
         cfg = self.config.node
-        if not node_url:
-            nodes = self.peers.recent_nodes()
-            if not nodes:
-                return "No nodes found."
-            node_url = random.choice(nodes)
         iface = NodeInterface(node_url, cfg, session=self._session())
         try:
             _, last_block = await self.manager.calculate_difficulty()

@@ -132,6 +132,22 @@ def clear_sig_verdicts() -> None:
         _SIG_VERDICTS.clear()
 
 
+def _resolve_backend(backend: str, n_checks: int) -> str:
+    """Apply the ``auto`` policy and the device-poison override (single
+    source for the cached and uncached layers)."""
+    if backend == "auto":
+        if n_checks < 8:
+            return "host"
+        return "device" if _device_usable() else "host"
+    if backend != "host" and _DEVICE_POISONED:
+        # an explicitly configured device backend must also honor the
+        # poison flag: re-paying device_timeout (and leaking another
+        # stuck daemon thread) on every block would stall the node 4 min
+        # per block after one hang
+        return "host"
+    return backend
+
+
 def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
                    pad_block: int = 128,
                    device_timeout: float = 240.0,
@@ -156,6 +172,14 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
     block is accepted — the reference pays that double verification
     (push_tx intake then check_block, transaction.py:185-238) on every
     gossiped tx.  Reorgs and sync re-accepts hit the same cache.
+
+    Only HOST-path verdicts are cached.  A device batch that silently
+    miscomputes (stale AOT cache entry, sick tunnel) would otherwise
+    turn one wrong verdict into a permanent one — replayed on every
+    re-accept even after the device path is poisoned off.  The benefit
+    survives: gossiped txs arrive one at a time, and batches under 8
+    signatures resolve to the host path, so intake still populates the
+    cache for the block accept that follows.
     """
     if not checks:
         return []
@@ -171,28 +195,22 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
                     _SIG_VERDICTS.move_to_end(c)
                     out[i] = v
         if misses:
+            miss_checks = [checks[i] for i in misses]
+            resolved = _resolve_backend(backend, len(miss_checks))
             fresh = run_sig_checks(
-                [checks[i] for i in misses], backend=backend,
+                miss_checks, backend=resolved,
                 pad_block=pad_block, device_timeout=device_timeout,
                 use_cache=False)
-            with _SIG_VERDICTS_LOCK:
-                for i, v in zip(misses, fresh):
-                    out[i] = v
-                    _SIG_VERDICTS[checks[i]] = v
-                while len(_SIG_VERDICTS) > _SIG_VERDICTS_MAX:
-                    _SIG_VERDICTS.popitem(last=False)
+            for i, v in zip(misses, fresh):
+                out[i] = v
+            if resolved == "host":
+                with _SIG_VERDICTS_LOCK:
+                    for i, v in zip(misses, fresh):
+                        _SIG_VERDICTS[checks[i]] = v
+                    while len(_SIG_VERDICTS) > _SIG_VERDICTS_MAX:
+                        _SIG_VERDICTS.popitem(last=False)
         return out  # type: ignore[return-value]
-    if backend == "auto":
-        if len(checks) < 8:
-            backend = "host"
-        else:
-            backend = "device" if _device_usable() else "host"
-    elif backend != "host" and _DEVICE_POISONED:
-        # an explicitly configured device backend must also honor the
-        # poison flag: re-paying device_timeout (and leaking another
-        # stuck daemon thread) on every block would stall the node 4 min
-        # per block after one hang
-        backend = "host"
+    backend = _resolve_backend(backend, len(checks))
     if backend == "host":
         from .. import native
 
