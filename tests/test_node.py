@@ -274,6 +274,38 @@ def test_sync_from_scratch(tmp_path, keys):
     run_cluster(tmp_path, scenario)
 
 
+def test_sync_retries_past_dead_peers(tmp_path, keys):
+    """sync_blockchain with no named peer must work around dead peers in
+    the book (connection errors raise out of fork detection) instead of
+    giving up on the first unlucky random pick — the reference tries
+    exactly one random peer per call (main.py:158-166)."""
+    async def scenario(cluster):
+        node_a, client_a = await cluster.add_node("a")
+        node_b, client_b = await cluster.add_node("b")
+        for _ in range(3):
+            assert (await mine_via_api(client_a, keys["addr"]))["ok"]
+        # two dead peers + the live one, with sampling pinned so the dead
+        # peers are ALWAYS tried first (random order would skip the retry
+        # path ~1/3 of runs and make this a flaky regression guard)
+        dead = ["http://127.0.0.1:9", "http://127.0.0.1:10"]
+        for url in dead:
+            node_b.peers.add(url)
+        node_b.peers.add(cluster.url(0))
+        import upow_tpu.node.app as app_mod
+
+        orig_sample = app_mod.random.sample
+        app_mod.random.sample = lambda pop, k: dead + [cluster.url(0)]
+        try:
+            result = await node_b.sync_blockchain()
+        finally:
+            app_mod.random.sample = orig_sample
+        assert result is True, result
+        assert (await node_a.state.get_unspent_outputs_hash()
+                == await node_b.state.get_unspent_outputs_hash())
+
+    run_cluster(tmp_path, scenario)
+
+
 def test_sync_with_transactions(tmp_path, keys):
     async def scenario(cluster):
         node_a, client_a = await cluster.add_node("a")
