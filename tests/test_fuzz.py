@@ -192,3 +192,46 @@ def test_sig_checks_survive_hung_device(monkeypatch):
                                    device_timeout=120.0,
                                    use_cache=False) == want
     assert _time.monotonic() - t1 < 10
+
+
+def test_sig_verdict_cache_thread_churn(monkeypatch):
+    """Hammer the verdict cache from concurrent threads with the LRU cap
+    shrunk so eviction races every lookup: every verdict must stay
+    correct and no OrderedDict mutation may raise (intake and block
+    verify really do run on different executor threads)."""
+    import concurrent.futures
+    import hashlib
+
+    from upow_tpu.core import curve
+    from upow_tpu.verify import txverify
+
+    d, pub = curve.keygen(rng=909)
+    checks, want = [], []
+    for i in range(60):
+        m = bytes([i % 251]) * 7
+        r, s = curve.sign(m, d)
+        ok = i % 4 != 3
+        if not ok:
+            s = (s + 1) % curve.CURVE_N
+        checks.append((hashlib.sha256(m).digest(),
+                       hashlib.sha256(m.hex().encode()).digest(), (r, s), pub))
+        want.append(txverify._host_verify_digest(
+            checks[-1][0], (r, s), pub) or txverify._host_verify_digest(
+            checks[-1][1], (r, s), pub))
+
+    monkeypatch.setattr(txverify, "_SIG_VERDICTS_MAX", 16)  # force eviction
+    txverify.clear_sig_verdicts()
+
+    def worker(seed):
+        import random as _r
+
+        rng = _r.Random(seed)
+        for _ in range(30):
+            idx = rng.sample(range(len(checks)), rng.randint(1, 12))
+            got = txverify.run_sig_checks([checks[i] for i in idx],
+                                          backend="host")
+            assert got == [want[i] for i in idx]
+        return True
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+        assert all(pool.map(worker, range(8)))

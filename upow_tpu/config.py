@@ -39,11 +39,38 @@ class DeviceConfig:
                                     # prefilter on block accept (worth it
                                     # with a real accelerator; on a CPU
                                     # node sqlite is already fast)
+    verify_kernel: str = ""         # "" = default | jac | complete
+    verify_window: int = 0          # 0 = default | 4 | 5  (jac ladder w)
 
     def resolve_search_backend(self, platform: str) -> str:
         if self.search_backend != "auto":
             return self.search_backend
         return "pallas" if platform == "tpu" else "jnp"
+
+    def apply_kernel_overrides(self) -> None:
+        """Push the A/B-able kernel knobs into crypto.p256 (module-level
+        so every dispatch path — node, bench, tests — sees one value).
+        No-op at defaults: importing p256 pulls in jax, which a host-path
+        node must not pay at startup."""
+        if not (self.verify_kernel or self.verify_window):
+            return
+        if self.verify_kernel and self.verify_kernel not in ("jac",
+                                                             "complete"):
+            raise ValueError(
+                f"device.verify_kernel must be 'jac' or 'complete', "
+                f"not {self.verify_kernel!r}")
+        window = self.verify_window
+        if window and (not isinstance(window, int) or isinstance(window, bool)
+                       or not 2 <= window <= 13):
+            raise ValueError(
+                f"device.verify_window must be an int in [2, 13], "
+                f"not {window!r}")
+        from .crypto import p256
+
+        if self.verify_kernel:
+            p256.PALLAS_KERNEL = self.verify_kernel
+        if window:
+            p256.PALLAS_JAC_WINDOW = window
 
 
 @dataclass

@@ -63,6 +63,7 @@ class Node:
     def __init__(self, config: Optional[Config] = None):
         self.config = config or Config()
         setup_logging(self.config.log)
+        self.config.device.apply_kernel_overrides()
         self.state = ChainState(self.config.node.db_path or None,
                                 device_index=self.config.device.utxo_index)
         self.manager = BlockManager(
