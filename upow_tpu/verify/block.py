@@ -86,6 +86,9 @@ class BlockManager:
         self._inode_cache: Optional[List[dict]] = None
         self._inode_cache_time = 0.0
         self.is_syncing = False
+        # transient page-level signature verdicts (chain-sync prefill):
+        # set by the node's create_blocks around a page's accept loop
+        self.page_sig_verdicts: Optional[dict] = None
 
     def invalidate_difficulty(self):
         self._difficulty_cache = None
@@ -191,7 +194,8 @@ class BlockManager:
         if not all(await run_sig_checks_async(
                 all_checks, backend=self.sig_backend,
                 pad_block=self.verify_pad_block,
-                device_timeout=self.verify_device_timeout)):
+                device_timeout=self.verify_device_timeout,
+                precomputed=self.page_sig_verdicts)):
             errors.append("signature verification failed")
             return False
 

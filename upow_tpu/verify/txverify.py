@@ -106,7 +106,8 @@ def _device_usable() -> bool:
 async def run_sig_checks_async(checks: Sequence[tuple],
                                backend: str = "auto",
                                pad_block: int = 128,
-                               device_timeout: float = 240.0) -> List[bool]:
+                               device_timeout: float = 240.0,
+                               precomputed=None) -> List[bool]:
     """Executor-wrapped :func:`run_sig_checks`: the device dispatch (and
     its hang time-box) must not block the node's event loop — the C++
     host batch and ctypes both release the GIL, so this also overlaps
@@ -117,7 +118,8 @@ async def run_sig_checks_async(checks: Sequence[tuple],
     return await asyncio.get_event_loop().run_in_executor(
         None, functools.partial(run_sig_checks, checks, backend=backend,
                                 pad_block=pad_block,
-                                device_timeout=device_timeout))
+                                device_timeout=device_timeout,
+                                precomputed=precomputed))
 
 
 _SIG_VERDICTS: "OrderedDict[tuple, bool]" = OrderedDict()
@@ -151,7 +153,8 @@ def _resolve_backend(backend: str, n_checks: int) -> str:
 def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
                    pad_block: int = 128,
                    device_timeout: float = 240.0,
-                   use_cache: bool = True) -> List[bool]:
+                   use_cache: bool = True,
+                   precomputed=None) -> List[bool]:
     """Verify deferred checks in one (or two) batched device calls.
 
     Pass 1 verifies against the raw-bytes digest; only failures re-try the
@@ -183,6 +186,21 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
     """
     if not checks:
         return []
+    if precomputed:
+        # page-level batch verdicts (chain-sync prefill): one device
+        # dispatch per sync page instead of one per block.  Transient —
+        # lives only for that page's accept loop, so it carries exactly
+        # the per-batch device trust the per-block dispatch would.
+        out_pre: List[Optional[bool]] = [precomputed.get(c) for c in checks]
+        rest_idx = [i for i, v in enumerate(out_pre) if v is None]
+        if rest_idx:
+            rest = run_sig_checks(
+                [checks[i] for i in rest_idx], backend=backend,
+                pad_block=pad_block, device_timeout=device_timeout,
+                use_cache=use_cache)
+            for i, v in zip(rest_idx, rest):
+                out_pre[i] = v
+        return out_pre  # type: ignore[return-value]
     if use_cache:
         out: List[Optional[bool]] = [None] * len(checks)
         misses = []
@@ -319,20 +337,38 @@ class TxVerifier:
 
     def __init__(self, state: ChainState, is_syncing: bool = False,
                  verify_pad_block: int = 128,
-                 verify_device_timeout: float = 240.0):
+                 verify_device_timeout: float = 240.0,
+                 tx_overlay: Optional[Dict[str, Tx]] = None):
         self.state = state
         self.is_syncing = is_syncing
         self.verify_pad_block = verify_pad_block
         self.verify_device_timeout = verify_device_timeout
+        # not-yet-accepted source txs (chain-sync page prefill): input
+        # resolution consults these before the chain state, so signature
+        # checks for a whole sync page can be collected up front even
+        # when a tx spends an output created earlier in the same page
+        self.tx_overlay = tx_overlay or {}
 
     # -- address resolution ------------------------------------------------
 
     async def input_address(self, tx_input) -> Optional[str]:
+        src = self.tx_overlay.get(tx_input.tx_hash)
+        if src is not None:
+            # coinbase sources included: spending a same-page miner
+            # reward is the common case two blocks into a sync page
+            if 0 <= tx_input.index < len(src.outputs):
+                return src.outputs[tx_input.index].address
+            return None
         return await self.state.resolve_output_address(tx_input.tx_hash, tx_input.index)
 
     async def voter_address(self, tx_input) -> Optional[str]:
         """For revoke inputs: the vote tx's FIRST input address
         (transaction_input.py:56-58, 79-82)."""
+        src = self.tx_overlay.get(tx_input.tx_hash)
+        if src is not None:
+            if src.is_coinbase or not src.inputs:
+                return None
+            return await self.input_address(src.inputs[0])
         info = await self.state.get_transaction_info(tx_input.tx_hash)
         if info is None or not info["inputs_addresses"]:
             tx = await self.state.get_transaction(tx_input.tx_hash, include_pending=True)
