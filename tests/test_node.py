@@ -948,6 +948,9 @@ def test_metrics_endpoint(tmp_path, keys):
         assert metrics["upow_mempool_transactions"] == 1
         assert metrics["upow_node_syncing"] == 0
         assert "upow_ws_connections" in metrics
+        # the push_tx intake above verified one signature -> cached
+        assert metrics["upow_sig_cache_entries"] >= 1
+        assert metrics["upow_sig_cache_misses_total"] >= 1
         # the block accept above registered timing spans
         assert any(k.startswith("upow_span_") and k.endswith("_count")
                    and v >= 1 for k, v in metrics.items())

@@ -366,6 +366,18 @@ class Node:
               "Peers messaged within the activity window")
         gauge("upow_node_syncing", int(bool(self.is_syncing)),
               "1 while a chain sync is in progress")
+        from ..verify.txverify import sig_verdict_stats
+
+        sig = sig_verdict_stats()
+        gauge("upow_sig_cache_entries", sig["size"],
+              "Entries in the signature-verdict cache")
+        for key, help_text in (
+                ("hits", "Signature checks answered from the verdict cache"),
+                ("misses", "Signature checks that required verification")):
+            name = f"upow_sig_cache_{key}_total"
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {sig[key]}")
         if self.ws_hub is not None:
             ws = self.ws_hub.get_stats()
             gauge("upow_ws_connections", ws["total_connections"],

@@ -126,12 +126,20 @@ _SIG_VERDICTS: "OrderedDict[tuple, bool]" = OrderedDict()
 _SIG_VERDICTS_MAX = 1 << 16
 _SIG_VERDICTS_LOCK = threading.Lock()  # intake + block verify run on
 # different executor threads; OrderedDict mutation is not atomic
+_SIG_VERDICT_STATS = {"hits": 0, "misses": 0}
+
+
+def sig_verdict_stats() -> dict:
+    """Cache size + hit/miss counters (observability: node /metrics)."""
+    with _SIG_VERDICTS_LOCK:
+        return {"size": len(_SIG_VERDICTS), **_SIG_VERDICT_STATS}
 
 
 def clear_sig_verdicts() -> None:
     """Drop the process-level signature-verdict cache (tests)."""
     with _SIG_VERDICTS_LOCK:
         _SIG_VERDICTS.clear()
+        _SIG_VERDICT_STATS["hits"] = _SIG_VERDICT_STATS["misses"] = 0
 
 
 def _resolve_backend(backend: str, n_checks: int) -> str:
@@ -212,6 +220,8 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
                 else:
                     _SIG_VERDICTS.move_to_end(c)
                     out[i] = v
+            _SIG_VERDICT_STATS["hits"] += len(checks) - len(misses)
+            _SIG_VERDICT_STATS["misses"] += len(misses)
         if misses:
             miss_checks = [checks[i] for i in misses]
             resolved = _resolve_backend(backend, len(miss_checks))
