@@ -18,7 +18,7 @@ import dataclasses
 import json
 import os
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Optional
 
 DEFAULT_SEED_URL = "https://api.upow.ai/"
 

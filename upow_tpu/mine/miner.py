@@ -25,7 +25,7 @@ from typing import Optional
 
 from ..core.clock import timestamp
 from ..core.merkle import miner_merkle_root
-from .engine import NONCE_SPACE, MiningJob, mine
+from .engine import MiningJob, mine
 
 GENESIS_PREV_HASH = (18_884_643).to_bytes(32, "little").hex()  # miner.py:37-40
 

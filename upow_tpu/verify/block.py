@@ -23,7 +23,6 @@ from decimal import Decimal
 from typing import List, Optional, Sequence, Tuple
 
 from ..core.clock import timestamp as now_ts
-from ..core.codecs import TransactionType
 from ..core.constants import MAX_BLOCK_SIZE_HEX, SMALLEST
 from ..core import difficulty as difficulty_rules
 from ..core.difficulty import BLOCKS_COUNT, LAST_BLOCK_FOR_GENESIS_KEY, check_pow

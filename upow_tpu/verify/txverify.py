@@ -22,7 +22,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..core.codecs import OutputType, TransactionType, string_to_point
 from ..core.constants import MAX_INODES, SMALLEST
-from ..core.tx import CoinbaseTx, Tx
+from ..core.tx import Tx
 from ..state.storage import ChainState, _INPUT_TABLE
 
 # The one grandfathered unstake tx exempt from the release-votes rule
@@ -325,7 +325,7 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
 
 def _host_verify_digest(digest: bytes, sig, pub) -> bool:
     from ..core import curve
-    from ..core.constants import CURVE_N, CURVE_P
+    from ..core.constants import CURVE_N
 
     r, s = sig
     if not (0 < r < CURVE_N and 0 < s < CURVE_N):
