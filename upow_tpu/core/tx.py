@@ -133,13 +133,23 @@ class Tx:
             raise NotImplementedError()
         self.version = version
         self._hash: Optional[str] = None
+        self._hex_cache: dict = {}
 
     @property
     def is_coinbase(self) -> bool:
         return False
 
     def hex(self, full: bool = True) -> str:
-        """Serialize; ``full=False`` is the signing form (transaction.py:46-83)."""
+        """Serialize; ``full=False`` is the signing form (transaction.py:46-83).
+
+        Memoized per instance (like ``hash``): block accept serializes
+        each tx several times (merkle sort, txid, size check, storage
+        row).  ``sign`` drops the full-form entry; mutating inputs or
+        outputs by hand after serializing is not supported — build or
+        parse, then sign."""
+        cached = self._hex_cache.get(full)
+        if cached is not None:
+            return cached
         out = [
             self.version.to_bytes(1, ENDIAN).hex(),
             len(self.inputs).to_bytes(1, ENDIAN).hex(),
@@ -151,6 +161,7 @@ class Tx:
 
         # v1/v2 sign over inputs+outputs only; v3 also signs the message.
         if not full and (self.version <= 2 or self.message is None):
+            self._hex_cache[full] = hexstring
             return hexstring
 
         if self.message is not None:
@@ -161,6 +172,7 @@ class Tx:
                 hexstring += len(self.message).to_bytes(2, ENDIAN).hex()
             hexstring += self.message.hex()
             if not full:
+                self._hex_cache[full] = hexstring
                 return hexstring
         else:
             hexstring += (0).to_bytes(1, ENDIAN).hex()
@@ -172,6 +184,7 @@ class Tx:
             if signed not in seen:
                 seen.append(signed)
                 hexstring += signed
+        self._hex_cache[full] = hexstring
         return hexstring
 
     def hash(self) -> str:
@@ -202,12 +215,14 @@ class Tx:
         from . import curve
 
         signing_bytes = bytes.fromhex(self.hex(False))
-        key_by_point = {curve.point_mul(d, curve.G): d for d in private_keys}
+        key_by_point = {curve.point_mul_G(d): d for d in private_keys}
         for tx_input in self.inputs:
             pub = pubkey_of(tx_input)
             d = key_by_point.get(pub)
             if d is not None:
                 tx_input.signature = curve.sign(signing_bytes, d)
+        self._hex_cache.pop(True, None)  # signatures changed
+        self._hash = None
         return self
 
     def __eq__(self, other):

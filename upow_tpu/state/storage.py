@@ -160,6 +160,17 @@ class ChainState:
         self._pending_cache: Optional[Dict[str, Tx]] = None
         self._pending_stamp: tuple = (-1, -1, -1)
         self._pending_gen = 0  # bumped on every LOCAL mempool mutation
+        from collections import OrderedDict as _OD
+
+        self._amount_cache: "_OD[tuple, int]" = _OD()
+
+    def _amount_cache_drop(self, tx_hashes) -> None:
+        """Forget cached output amounts for deleted txs (see
+        get_output_amount: existence must not depend on cache warmth)."""
+        gone = set(tx_hashes)
+        if gone:
+            for key in [k for k in self._amount_cache if k[0] in gone]:
+                del self._amount_cache[key]
 
     def _pending_decoded(self) -> Dict[str, Tx]:
         # (count, max rowid) detects writes from OTHER connections (the
@@ -345,6 +356,7 @@ class ChainState:
             "DELETE FROM transactions WHERE tx_hash = ?", [(h,) for h in created]
         )
         self.db.execute("DELETE FROM blocks WHERE id >= ?", (from_block_id,))
+        self._amount_cache_drop(created)
         self._commit()
         self._index_rebuild()  # reorgs are rare; a bulk resync is ms
 
@@ -473,20 +485,38 @@ class ChainState:
         return tx.fees(total_in)
 
     async def get_output_amount(self, tx_hash: str, index: int) -> Optional[int]:
+        # content-addressed (tx_hash = sha256(full tx hex), so a hash's
+        # outputs can never change), but existence matters: tx_fees
+        # returns 0 when the source tx is GONE, and that decision must
+        # not depend on cache warmth (consensus-adjacent — it feeds the
+        # coinbase miner_amount).  Every path that deletes txs
+        # (remove_blocks, pending removals) drops the affected entries.
+        key = (tx_hash, index)
+        amount = self._amount_cache.get(key)
+        if amount is not None:
+            return amount
         r = self.db.execute(
             "SELECT outputs_amounts FROM transactions WHERE tx_hash = ?",
             (tx_hash,),
         ).fetchone()
         if r is not None:
             amounts = json.loads(r["outputs_amounts"])
-            return amounts[index] if index < len(amounts) else None
-        r = self.db.execute(
-            "SELECT tx_hex FROM pending_transactions WHERE tx_hash = ?", (tx_hash,)
-        ).fetchone()
-        if r is None:
-            return None
-        tx = tx_from_hex(r["tx_hex"], check_signatures=False)
-        return tx.outputs[index].amount if index < len(tx.outputs) else None
+            amount = amounts[index] if index < len(amounts) else None
+        else:
+            r = self.db.execute(
+                "SELECT tx_hex FROM pending_transactions WHERE tx_hash = ?",
+                (tx_hash,),
+            ).fetchone()
+            if r is None:
+                return None
+            tx = tx_from_hex(r["tx_hex"], check_signatures=False)
+            amount = (tx.outputs[index].amount
+                      if index < len(tx.outputs) else None)
+        if amount is not None:
+            self._amount_cache[key] = amount
+            while len(self._amount_cache) > (1 << 16):
+                self._amount_cache.popitem(last=False)
+        return amount
 
     # ------------------------------------------------------------ mempool --
 
@@ -574,12 +604,14 @@ class ChainState:
             self.db.execute(
                 f"DELETE FROM pending_transactions WHERE tx_hash IN ({ph})",
                 chunk)
+        self._amount_cache_drop(hashes)
         self._commit()
         self._pending_gen += 1
 
     async def remove_pending_transactions(self) -> None:
         self.db.execute("DELETE FROM pending_transactions")
         self.db.execute("DELETE FROM pending_spent_outputs")
+        self._amount_cache.clear()
         self._commit()
         self._pending_gen += 1
 
