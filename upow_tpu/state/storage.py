@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import os
 import sqlite3
+import time as _time
 from contextlib import asynccontextmanager
 from decimal import Decimal
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
@@ -162,7 +163,41 @@ class ChainState:
         self._pending_gen = 0  # bumped on every LOCAL mempool mutation
         from collections import OrderedDict as _OD
 
-        self._amount_cache: "_OD[tuple, int]" = _OD()
+        self._amount_cache: "_OD[tuple, object]" = _OD()
+        self._data_version = self._db_data_version()
+        self._data_version_checked = 0.0
+
+    def _db_data_version(self) -> int:
+        return self.db.execute("PRAGMA data_version").fetchone()[0]
+
+    def _amount_cache_get(self, key):
+        """Cached output amount/address, guarded against writes from
+        OTHER connections on the same db file (the wallet CLI opens its
+        own ChainState): sqlite's data_version counter bumps whenever a
+        different connection commits, and any such commit may have
+        deleted source txs — so the whole memo is dropped then.
+
+        The version check is rate-limited to one PRAGMA per 50 ms — at
+        ~25k lookups per 8k-tx block the per-hit pragma cost halved the
+        warm accept rate.  The window only affects SECONDARY processes
+        reading a file another process mutates (this connection's own
+        deletions invalidate explicitly and see no window); those reads
+        race ongoing commits by >=50 ms anyway.
+        """
+        now = _time.monotonic()
+        if now - self._data_version_checked >= 0.05:
+            self._data_version_checked = now
+            version = self._db_data_version()
+            if version != self._data_version:
+                self._data_version = version
+                self._amount_cache.clear()
+                return None
+        return self._amount_cache.get(key)
+
+    def _amount_cache_put(self, key, value) -> None:
+        self._amount_cache[key] = value
+        while len(self._amount_cache) > (1 << 16):
+            self._amount_cache.popitem(last=False)
 
     def _amount_cache_drop(self, tx_hashes) -> None:
         """Forget cached output amounts for deleted txs (see
@@ -254,6 +289,7 @@ class ChainState:
             self.db.commit()
         except BaseException:
             self.db.rollback()
+            self._amount_cache.clear()  # may hold rolled-back rows
             self._index_rebuild()  # undo any index updates the txn made
             raise
         finally:
@@ -455,7 +491,13 @@ class ChainState:
 
     async def resolve_output_address(self, tx_hash: str, index: int) -> Optional[str]:
         """AddressResolver for the codec's ambiguous-signature relink
-        (core/tx.py tx_from_hex)."""
+        (core/tx.py tx_from_hex).  Memoized with the same
+        content-addressed + dropped-on-tx-deletion discipline as
+        :func:`get_output_amount` (shared cache, misses not cached)."""
+        key = (tx_hash, -1 - index)  # distinct key space from amounts
+        addr = self._amount_cache_get(key)
+        if addr is not None:
+            return addr
         r = self.db.execute(
             "SELECT outputs_addresses FROM transactions WHERE tx_hash = ?",
             (tx_hash,),
@@ -468,9 +510,14 @@ class ChainState:
             if r is None:
                 return None
             tx = tx_from_hex(r["tx_hex"], check_signatures=False)
-            return tx.outputs[index].address if index < len(tx.outputs) else None
-        addresses = json.loads(r["outputs_addresses"])
-        return addresses[index] if index < len(addresses) else None
+            addr = (tx.outputs[index].address
+                    if index < len(tx.outputs) else None)
+        else:
+            addresses = json.loads(r["outputs_addresses"])
+            addr = addresses[index] if index < len(addresses) else None
+        if addr is not None:
+            self._amount_cache_put(key, addr)
+        return addr
 
     async def tx_fees(self, tx: AnyTx) -> int:
         """fee = Σ input amounts − Σ output amounts (int smallest units)."""
@@ -492,7 +539,7 @@ class ChainState:
         # coinbase miner_amount).  Every path that deletes txs
         # (remove_blocks, pending removals) drops the affected entries.
         key = (tx_hash, index)
-        amount = self._amount_cache.get(key)
+        amount = self._amount_cache_get(key)
         if amount is not None:
             return amount
         r = self.db.execute(
@@ -513,9 +560,7 @@ class ChainState:
             amount = (tx.outputs[index].amount
                       if index < len(tx.outputs) else None)
         if amount is not None:
-            self._amount_cache[key] = amount
-            while len(self._amount_cache) > (1 << 16):
-                self._amount_cache.popitem(last=False)
+            self._amount_cache_put(key, amount)
         return amount
 
     # ------------------------------------------------------------ mempool --
@@ -586,6 +631,7 @@ class ChainState:
         pending table finds every tx whose overlay needs cleanup — no
         per-hash lookup, no re-parsing just-accepted txs out of the
         transactions table."""
+        to_drop: List[str] = []
         for i in range(0, len(hashes), 500):
             chunk = hashes[i:i + 500]
             ph = ",".join("?" * len(chunk))
@@ -604,7 +650,11 @@ class ChainState:
             self.db.execute(
                 f"DELETE FROM pending_transactions WHERE tx_hash IN ({ph})",
                 chunk)
-        self._amount_cache_drop(hashes)
+            confirmed = {r["tx_hash"] for r in self.db.execute(
+                f"SELECT tx_hash FROM transactions WHERE tx_hash IN ({ph})",
+                chunk).fetchall()}
+            to_drop.extend(h for h in chunk if h not in confirmed)
+        self._amount_cache_drop(to_drop)
         self._commit()
         self._pending_gen += 1
 
