@@ -499,6 +499,55 @@ def test_device_utxo_index_matches_sql(keys, monkeypatch):
     assert on[2] == [False, True, False]
 
 
+def test_amount_cache_cleared_on_rollback():
+    """Output amounts warmed from rows inserted inside a failed atomic()
+    must not survive the rollback (they feed tx_fees -> the coinbase)."""
+    async def scenario():
+        state = ChainState()
+        fake_hash = "ab" * 32
+        with pytest.raises(RuntimeError, match="boom"):
+            async with state.atomic():
+                state.db.execute(
+                    "INSERT INTO transactions (block_hash, tx_hash, tx_hex,"
+                    " inputs_addresses, outputs_addresses, outputs_amounts,"
+                    " fees) VALUES ('b', ?, '00', '[]', '[\"x\"]', '[77]', 0)",
+                    (fake_hash,))
+                # a lookup inside the txn sees (and caches) the row
+                assert await state.get_output_amount(fake_hash, 0) == 77
+                raise RuntimeError("boom")
+        assert await state.get_output_amount(fake_hash, 0) is None
+        state.close()
+
+    run(scenario())
+
+
+def test_amount_cache_sees_other_connection_deletes(tmp_path, keys):
+    """A second ChainState on the same db file (the wallet CLI pattern)
+    must notice deletions committed by the first within the 50 ms
+    data_version window."""
+    async def scenario():
+        import time as _t
+
+        db = str(tmp_path / "shared.db")
+        node = ChainState(db)
+        manager = BlockManager(node, sig_backend="host")
+        await mine_and_accept(manager, node, keys["a1"], ts_offset=-3)
+        tx = await make_send(node, keys["d1"], keys["a1"], keys["a2"],
+                             1 * SMALLEST)
+        await node.add_pending_transaction(tx)
+
+        wallet = ChainState(db)
+        assert await wallet.get_output_amount(tx.hash(), 0) is not None
+
+        await node.remove_pending_transactions()  # node wipes the mempool
+        _t.sleep(0.06)  # past the wallet's rate-limited version check
+        assert await wallet.get_output_amount(tx.hash(), 0) is None
+        wallet.close()
+        node.close()
+
+    run(scenario())
+
+
 def test_reindex_tool(tmp_path, keys):
     """python -m upow_tpu.state.reindex --check: the replay oracle as an
     operator tool (reference create_unspent_outputs.py)."""
