@@ -48,6 +48,7 @@ def make_config(tmp_path, name: str) -> Config:
     cfg.node.seed_url = ""           # no external seed
     cfg.node.peers_file = str(tmp_path / f"{name}_nodes.json")
     cfg.node.ip_config_file = ""
+    cfg.node.sync_fetch_interval = 0.0  # no pacing floor in tests
     cfg.ws.enabled = True
     cfg.device.sig_backend = "host"
     cfg.log.path = ""
@@ -270,6 +271,51 @@ def test_sync_from_scratch(tmp_path, keys):
         assert await node_b.state.get_next_block_id() == 4
         assert (await node_a.state.get_unspent_outputs_hash()
                 == await node_b.state.get_unspent_outputs_hash())
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_sync_multi_page_with_prefetch(tmp_path, keys):
+    """Paged download with the speculative next-page fetch in flight:
+    7 blocks at page size 2 -> 4 pages, every boundary crossed, and the
+    final short page terminates the loop.  Fingerprints must match."""
+    async def scenario(cluster):
+        node_a, client_a = await cluster.add_node("a")
+        node_b, client_b = await cluster.add_node("b")
+        node_b.config.node.sync_page = 2
+        for _ in range(7):
+            assert (await mine_via_api(client_a, keys["addr"]))["ok"]
+        res = await (await client_b.get(
+            "/sync_blockchain", params={"node_url": cluster.url(0)})).json()
+        assert res["ok"], res
+        assert await node_b.state.get_next_block_id() == 8
+        assert (await node_a.state.get_unspent_outputs_hash()
+                == await node_b.state.get_unspent_outputs_hash())
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_sync_fetch_pacing_floor(tmp_path, keys):
+    """get_blocks fetches respect node.sync_fetch_interval even with the
+    prefetch pipeline (the peer hard-limits /get_blocks to 40/min)."""
+    async def scenario(cluster):
+        import time as _t
+
+        node_a, client_a = await cluster.add_node("a")
+        node_b, client_b = await cluster.add_node("b")
+        node_b.config.node.sync_page = 2
+        node_b.config.node.sync_fetch_interval = 0.15
+        for _ in range(5):
+            assert (await mine_via_api(client_a, keys["addr"]))["ok"]
+        t0 = _t.monotonic()
+        res = await (await client_b.get(
+            "/sync_blockchain", params={"node_url": cluster.url(0)})).json()
+        elapsed = _t.monotonic() - t0
+        assert res["ok"], res
+        assert await node_b.state.get_next_block_id() == 6
+        # 5 blocks / page 2 -> >=3 pages + the empty terminator = >=4
+        # fetches; with a 0.15 s floor the 2nd..4th cost >=0.45 s total
+        assert elapsed >= 0.45, elapsed
 
     run_cluster(tmp_path, scenario)
 

@@ -866,6 +866,8 @@ class Node:
         named peer."""
         cfg = self.config.node
         iface = NodeInterface(node_url, cfg, session=self._session())
+        prefetch: Optional[asyncio.Task] = None
+        prefetch_from = None
         try:
             _, last_block = await self.manager.calculate_difficulty()
             starting_from = i = await self.state.get_next_block_id()
@@ -890,10 +892,47 @@ class Node:
                             await self.state.remove_blocks(last_common_block + 1)
                             break
             errors: list = []
+            # pipelined download: while page k is verified/accepted, page
+            # k+1 is already in flight (accept work and peer I/O overlap;
+            # the reference fetches and accepts strictly serially,
+            # main.py:188-192).  The prefetch targets the EXPECTED next
+            # offset; if accept rejects part of a page the speculative
+            # fetch is discarded.
+            last_fetch = [0.0]
+
+            async def fetch_page(offset):
+                # pace below the peer's server-side 40/min get_blocks
+                # limit (ratelimit.py:26) — pipelining would otherwise
+                # raise the request rate to one per max(fetch, accept)
+                wait = cfg.sync_fetch_interval - (
+                    time.monotonic() - last_fetch[0])
+                if wait > 0:
+                    await asyncio.sleep(wait)
+                last_fetch[0] = time.monotonic()
+                return await iface.get_blocks(offset, cfg.sync_page)
+
             while True:
                 i = await self.state.get_next_block_id()
                 try:
-                    blocks = await iface.get_blocks(i, cfg.sync_page)
+                    if prefetch is not None and prefetch_from == i:
+                        try:
+                            blocks = await prefetch
+                        except Exception as e:
+                            # a transient blip on the SPECULATIVE fetch
+                            # must not abort a multi-thousand-block sync;
+                            # one direct retry at consumption time
+                            log.info("prefetch of page %s failed (%s); "
+                                     "retrying directly", i, e)
+                            blocks = await fetch_page(i)
+                    else:
+                        if prefetch is not None:
+                            prefetch.cancel()
+                        blocks = await fetch_page(i)
+                    prefetch = None
+                    if len(blocks) == cfg.sync_page:
+                        prefetch_from = i + cfg.sync_page
+                        prefetch = asyncio.ensure_future(
+                            fetch_page(prefetch_from))
                 except Exception as e:
                     # a failed page (peer down, response cap, or the
                     # peer's 40/minute get_blocks rate limit on a long
@@ -927,6 +966,12 @@ class Node:
                     return errors[0] if errors else e
             # unreachable: the loop exits only via the returns above
         finally:
+            if prefetch is not None:
+                prefetch.cancel()
+                try:
+                    await prefetch
+                except (asyncio.CancelledError, Exception):
+                    pass
             await iface.close()
 
     async def create_blocks(self, blocks: list,
