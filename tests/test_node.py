@@ -374,6 +374,18 @@ def test_sync_page_prefills_sig_verdicts(tmp_path, keys, monkeypatch):
     run_cluster(tmp_path, scenario)
 
 
+def test_node_interface_unwraps_peer_errors():
+    """A peer's error envelope (e.g. its 40/min rate-limit body) must
+    surface as a readable error, not a KeyError on 'result'."""
+    from upow_tpu.node.peers import NodeInterface
+
+    assert NodeInterface._result({"ok": True, "result": [1]}) == [1]
+    with pytest.raises(RuntimeError, match="Rate limit"):
+        NodeInterface._result({"ok": False, "error": "Rate limit exceeded"})
+    with pytest.raises(RuntimeError, match="peer error"):
+        NodeInterface._result({})
+
+
 def test_sync_retries_past_dead_peers(tmp_path, keys):
     """sync_blockchain with no named peer must work around dead peers in
     the book (connection errors raise out of fork detection) instead of

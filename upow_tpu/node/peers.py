@@ -188,16 +188,23 @@ class NodeInterface:
                                params=params or {}, headers=headers) as resp:
             return await self._read_capped(resp)
 
-    async def get_block(self, block_no: int) -> dict:
-        res = await self.get("get_block", {"block": str(block_no),
-                                           "full_transactions": "false"})
+    @staticmethod
+    def _result(res: dict):
+        """Unwrap an RPC envelope; a peer's error/rate-limit body becomes
+        a readable error instead of a bare KeyError."""
+        if "result" not in res:
+            raise RuntimeError(
+                f"peer error: {res.get('error', res)!s:.200}")
         return res["result"]
+
+    async def get_block(self, block_no: int) -> dict:
+        return self._result(await self.get(
+            "get_block", {"block": str(block_no),
+                          "full_transactions": "false"}))
 
     async def get_blocks(self, offset: int, limit: int) -> list:
-        res = await self.get("get_blocks", {"offset": str(offset),
-                                            "limit": str(limit)})
-        return res["result"]
+        return self._result(await self.get(
+            "get_blocks", {"offset": str(offset), "limit": str(limit)}))
 
     async def get_nodes(self) -> list:
-        res = await self.get("get_nodes")
-        return res["result"]
+        return self._result(await self.get("get_nodes"))
