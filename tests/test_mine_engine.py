@@ -73,6 +73,21 @@ def test_build_job_defaults_genesis():
     assert job.previous_hash == (18_884_643).to_bytes(32, "little").hex()
 
 
+def test_fetch_mining_info_unwraps_node_errors(monkeypatch):
+    """A node error envelope (syncing, rate-limited) surfaces readably,
+    not as KeyError('result')."""
+    from upow_tpu.mine import miner as miner_mod
+
+    monkeypatch.setattr(miner_mod, "_http_json",
+                        lambda url, **kw: {"ok": False,
+                                           "error": "Node is already syncing"})
+    with pytest.raises(RuntimeError, match="syncing"):
+        miner_mod.fetch_mining_info("http://x/")
+    monkeypatch.setattr(miner_mod, "_http_json",
+                        lambda url, **kw: {"ok": True, "result": {"a": 1}})
+    assert miner_mod.fetch_mining_info("http://x/") == {"a": 1}
+
+
 def test_hang_watchdog_trips_on_stale_heartbeat():
     """A dead-tunnel dispatch hangs forever; the watchdog must fire once
     the heartbeat goes stale, and not before while it is refreshed."""

@@ -42,7 +42,10 @@ def _http_json(url: str, payload: Optional[dict] = None, timeout: float = 20.0) 
 
 
 def fetch_mining_info(node: str) -> dict:
-    return _http_json(node + "get_mining_info")["result"]
+    res = _http_json(node + "get_mining_info")
+    if "result" not in res:  # readable node error, not KeyError
+        raise RuntimeError(f"node error: {res.get('error', res)!s:.200}")
+    return res["result"]
 
 
 def build_job(info: dict, address: str) -> tuple:
@@ -131,7 +134,10 @@ def run(address: str, node: str, device: str, batch: int, ttl: float,
         heartbeat["t"] = time.monotonic()
         try:
             info = fetch_mining_info(node)
-        except (urllib.error.URLError, OSError, ValueError) as e:
+        except (urllib.error.URLError, OSError, ValueError,
+                RuntimeError) as e:
+            # RuntimeError carries a node error envelope (syncing,
+            # rate-limited) — transient, retry like unreachable
             print(f"node unreachable: {e}; retrying", file=sys.stderr)
             time.sleep(1)
             continue
