@@ -94,8 +94,20 @@ class Node:
         task.add_done_callback(self._background.discard)
 
     async def close(self) -> None:
+        # cancel AND await: a cancelled task only unwinds at its next
+        # suspension point — closing the db before it does would hand a
+        # still-running task a closed connection.  Bounded: a task stuck
+        # inside run_in_executor (device verify) cannot be cancelled
+        # until the executor call returns, and shutdown must not wait
+        # out a 240 s device timeout.
         for task in list(self._background):
             task.cancel()
+        if self._background:
+            done, stragglers = await asyncio.wait(
+                list(self._background), timeout=5.0)
+            for task in stragglers:
+                log.warning("background task still running at close: %r",
+                            task)
         if self._http_session is not None and not self._http_session.closed:
             await self._http_session.close()
         self.state.close()
@@ -220,7 +232,8 @@ class Node:
         except web.HTTPException:
             raise
         except Exception as e:  # exception envelope (main.py:394-406)
-            log.error("Error on %s, %s: %s", request.path, type(e).__name__, e)
+            log.error("Error on %s, %s: %s", request.path, type(e).__name__,
+                      e, exc_info=True)
             return web.json_response(
                 {"ok": False, "error": f"Uncaught {type(e).__name__} exception"},
                 status=500)
