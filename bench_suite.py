@@ -1,12 +1,16 @@
-"""The five BASELINE.json measurement configs, one JSON line each.
+"""The five BASELINE.json measurement configs plus the block-accept
+config, one JSON line each.
 
-    python bench_suite.py [--configs 1,2,3,4,5] [--seconds N]
+    python bench_suite.py [--configs 1,2,3,4,5,6] [--seconds N]
 
 1. miner single-block sha256 at difficulty 1 (CPU reference loop)
 2. fractional difficulty 6.3 mine (charset-restricted prefix match)
 3. 8k-tx block P-256 ECDSA batch-verify
 4. full-chain replay validate (rebuild_utxos + fingerprint oracle)
 5. mesh-sharded nonce search at difficulty 8 (all visible devices)
+6. full 8,160-tx block accept through BlockManager, cold (signatures
+   never seen) and warm (every tx intake-verified first — the gossip
+   profile, where the verdict cache removes signature work)
 
 ``bench.py`` stays the driver-facing single-line headline (sha256 search);
 this suite is the full scoreboard.  Each line mirrors bench.py's shape:
