@@ -45,6 +45,12 @@ from .constants import ENDIAN, SMALLEST
 Signature = Tuple[int, int]
 
 
+class AmbiguousSignatureError(ValueError):
+    """Signature count matches neither 1 nor the input count: relinking
+    needs the address resolver (transaction.py:148-163 resolves through
+    the Database)."""
+
+
 @dataclass
 class TxInput:
     """A reference to a spendable output (transaction_input.py:11-98)."""
@@ -352,7 +358,7 @@ def tx_from_hex(
             tx_input.signature = signed
     elif check_signatures:
         if resolve_address is None:
-            raise ValueError(
+            raise AmbiguousSignatureError(
                 "ambiguous signature layout needs an address resolver "
                 f"({len(inputs)} inputs, {len(signatures)} signatures)"
             )

@@ -32,7 +32,7 @@ from ..core.clock import timestamp
 from ..core.rewards import get_circulating_supply
 from ..core.header import block_to_bytes, split_block_content
 from ..core.merkle import merkle_root
-from ..core.tx import CoinbaseTx, Tx, tx_from_hex
+from ..core.tx import AmbiguousSignatureError, CoinbaseTx, Tx, tx_from_hex
 from ..logger import get_logger, setup_logging
 from ..state.storage import ChainState
 from ..verify.block import BlockManager
@@ -428,13 +428,17 @@ class Node:
 
     async def _parse_tx(self, tx_hex: str, overlay: Optional[dict] = None):
         """Decode with the ambiguous-signature relink resolved against state
-        (core/tx.py tx_from_hex needs a sync resolver; pre-fetch the input
-        addresses with a first signature-free parse).  ``overlay`` maps
-        tx_hash -> parsed Tx for sources not yet in state (earlier blocks
-        of the same sync page)."""
+        (core/tx.py tx_from_hex needs a sync resolver).  The resolver is
+        only consulted when the signature count matches neither 1 nor the
+        input count, so the common case is ONE parse; only the ambiguous
+        layout pays the signature-free pre-parse that gathers input
+        addresses.  ``overlay`` maps tx_hash -> parsed Tx for sources not
+        yet in state (earlier blocks of the same sync page)."""
+        try:
+            return tx_from_hex(tx_hex, check_signatures=True)
+        except AmbiguousSignatureError:
+            pass
         tx = tx_from_hex(tx_hex, check_signatures=False)
-        if tx.is_coinbase:
-            return tx
         addrs = {}
         for i in tx.inputs:
             src = overlay.get(i.tx_hash) if overlay else None
