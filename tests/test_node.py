@@ -819,7 +819,8 @@ def test_randomized_churn_soak(tmp_path, keys, monkeypatch):
 
         # funding prefix, longer than the reorg window
         for _ in range(6):
-            assert (await mine_via_api(clients[0], keys["addr"]))["ok"]
+            res = await mine_via_api(clients[0], keys["addr"])
+            assert res["ok"], res
         await converge({0, 1, 2})
 
         for rnd in range(rounds):
@@ -834,7 +835,8 @@ def test_randomized_churn_soak(tmp_path, keys, monkeypatch):
                     await nodes[miner_i].state.add_pending_transaction(tx)
                 except ValueError:
                     pass  # no spendable outputs on this node's view yet
-            assert (await mine_via_api(clients[miner_i], keys["addr"]))["ok"]
+            res = await mine_via_api(clients[miner_i], keys["addr"])
+            assert res["ok"], res
             await converge({0, 1, 2})
 
             if rng.random() < 0.4:
@@ -849,12 +851,13 @@ def test_randomized_churn_soak(tmp_path, keys, monkeypatch):
                     # NB the genesis-key emission gate (manager.py:679-689):
                     # with no registered inodes only the genesis address may
                     # mine, so the fork differs by timestamp, not miner
-                    assert (await mine_via_api(clients[victim],
-                                               keys["addr"]))["ok"]
+                    res = await mine_via_api(clients[victim], keys["addr"])
+                    assert res["ok"], res
                 # majority extends further so the victim must reorg
                 for _ in range(3):
-                    assert (await mine_via_api(clients[others[0]],
-                                               keys["addr"]))["ok"]
+                    res = await mine_via_api(clients[others[0]],
+                                             keys["addr"])
+                    assert res["ok"], res
                 await converge(set(others))
                 # heal
                 for i in others:

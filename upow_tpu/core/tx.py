@@ -366,6 +366,13 @@ def tx_from_hex(
         for tx_input in inputs:
             address = resolve_address(tx_input.tx_hash, tx_input.index)
             index.setdefault(address, []).append(tx_input)
+        if len(signatures) > len(index):
+            # the reference's relink would IndexError here
+            # (transaction.py:148-163 groups by address then indexes by
+            # signature position); reject the same inputs, cleanly
+            raise ValueError(
+                f"{len(signatures)} signatures for "
+                f"{len(index)} distinct input addresses")
         for i, signed in enumerate(signatures):
             for tx_input in index[list(index.keys())[i]]:
                 tx_input.signature = signed
