@@ -102,15 +102,24 @@ def run_cluster(tmp_path, scenario):
     asyncio.run(main())
 
 
-async def mine_via_api(client: TestClient, address: str) -> dict:
+async def mine_via_api(client: TestClient, address: str,
+                       _retried: bool = False) -> dict:
     """Drive the miner protocol over HTTP: get_mining_info → search →
-    push_block (reference miner.py:126-156)."""
+    push_block (reference miner.py:126-156).
+
+    Like the real miner loop, transient rejections refetch the template
+    once: get_mining_info SPAWNS the interval mempool GC (app mirrors
+    main.py:678-683), so a pending hash listed in the template can be
+    evicted before push_block lands — the reference has the identical
+    race and its miner just grabs a fresh template."""
     from upow_tpu.core import clock
     from upow_tpu.core.difficulty import BLOCK_TIME
 
     # one BLOCK_TIME per block: monotonic timestamps AND a neutral
     # retarget ratio, so arbitrarily long soaks keep difficulty ~1.0
-    clock.advance(BLOCK_TIME)
+    # (the retry must NOT advance again — one block, one tick)
+    if not _retried:
+        clock.advance(BLOCK_TIME)
     resp = await client.get("/get_mining_info")
     info = (await resp.json())["result"]
     last_block = dict(info["last_block"])
@@ -135,7 +144,12 @@ async def mine_via_api(client: TestClient, address: str) -> dict:
         "txs": pending_hashes,
         "block_no": last_block.get("id", 0) + 1,
     })
-    return await resp.json()
+    res = await resp.json()
+    if not res.get("ok") and not _retried and any(
+            s in str(res.get("error", ""))
+            for s in ("Transaction hash not found", "already syncing")):
+        return await mine_via_api(client, address, _retried=True)
+    return res
 
 
 # --------------------------------------------------------------- basics ----
