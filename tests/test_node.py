@@ -81,12 +81,15 @@ class Cluster:
         return f"http://127.0.0.1:{self.servers[i].port}"
 
     async def close(self):
-        for node in self.nodes:
-            await node.close()
+        # servers/clients first: a request draining through a live server
+        # would otherwise reach a node whose db is already closed (its
+        # middleware spawns work per response)
         for client in self.clients:
             await client.close()
         for server in self.servers:
             await server.close()
+        for node in self.nodes:
+            await node.close()
 
 
 def run_cluster(tmp_path, scenario):
@@ -557,6 +560,58 @@ def test_ws_transaction_broadcast(tmp_path, keys):
         assert msg["type"] == "new_transaction"
         assert msg["data"]["tx_hash"] == tx.hash()
         await ws.close()
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_ws_limits(tmp_path, keys):
+    """Reference socket limits (socket_config.py:6-43): per-IP connection
+    cap, per-connection message rate limit, unsubscribe semantics —
+    including unsubscribe-without-subscribe, and subscribe_transaction
+    actually working (unreachable in the reference, which omits it from
+    ALLOWED_MESSAGE_TYPES)."""
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        node.ws_hub.cfg.max_per_user = 2
+        node.ws_hub.cfg.rate_limit_per_minute = 5
+
+        ws1 = await client.ws_connect("/ws")
+        await ws1.receive()
+        ws2 = await client.ws_connect("/ws")
+        await ws2.receive()
+        # third connection from the same IP: rejected with 403
+        import aiohttp
+
+        with pytest.raises(aiohttp.WSServerHandshakeError):
+            await client.ws_connect("/ws")
+
+        # rate limit: 5 allowed per minute, the 6th gets RATE_LIMIT
+        for _ in range(5):
+            await ws1.send_str(json.dumps({"type": "ping"}))
+            assert json.loads((await ws1.receive()).data)["type"] == "pong"
+        await ws1.send_str(json.dumps({"type": "ping"}))
+        err = json.loads((await ws1.receive()).data)
+        assert err["type"] == "error"
+        assert err["error_code"] == "RATE_LIMIT_EXCEEDED"
+
+        # unsubscribe without subscribe -> NOT_SUBSCRIBED
+        await ws2.send_str(json.dumps({"type": "unsubscribe_block"}))
+        err = json.loads((await ws2.receive()).data)
+        assert err["error_code"] == "NOT_SUBSCRIBED"
+        # subscribe/unsubscribe transaction round-trip
+        await ws2.send_str(json.dumps({"type": "subscribe_transaction"}))
+        assert json.loads((await ws2.receive()).data)["type"] == "success"
+        await ws2.send_str(json.dumps({"type": "unsubscribe_transaction"}))
+        assert json.loads((await ws2.receive()).data)["type"] == "success"
+        # malformed JSON -> INVALID_JSON, connection stays up
+        await ws2.send_str("{nope")
+        err = json.loads((await ws2.receive()).data)
+        assert err["error_code"] == "INVALID_JSON"
+
+        stats = node.ws_hub.get_stats()
+        assert stats["total_connections"] == 2
+        await ws1.close()
+        await ws2.close()
 
     run_cluster(tmp_path, scenario)
 

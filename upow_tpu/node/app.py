@@ -81,6 +81,7 @@ class Node:
         self.self_url = self.config.node.self_url
         self.tx_cache: deque = deque(maxlen=100)
         self._last_mempool_clean = 0
+        self._closing = False
         self._background: set = set()
         self._http_session = None  # shared gossip/RPC session, lazy
         self.ws_hub = None  # set by ws.attach(...) when enabled
@@ -88,12 +89,19 @@ class Node:
 
     # ----------------------------------------------------------- plumbing --
     def _spawn(self, coro) -> None:
-        """Fire-and-forget background task (FastAPI BackgroundTasks role)."""
+        """Fire-and-forget background task (FastAPI BackgroundTasks role).
+        Refused once close() has begun — a request draining through the
+        server during shutdown must not start work against a database
+        that is about to be (or already is) closed."""
+        if self._closing:
+            coro.close()
+            return
         task = asyncio.ensure_future(coro)
         self._background.add(task)
         task.add_done_callback(self._background.discard)
 
     async def close(self) -> None:
+        self._closing = True
         # cancel AND await: a cancelled task only unwinds at its next
         # suspension point — closing the db before it does would hand a
         # still-running task a closed connection.  Bounded: a task stuck
