@@ -150,7 +150,16 @@ async def mine_via_api(client: TestClient, address: str,
     res = await resp.json()
     if not res.get("ok") and not _retried and any(
             s in str(res.get("error", ""))
-            for s in ("Transaction hash not found", "already syncing")):
+            for s in ("Transaction hash not found", "already syncing",
+                      "Too old block")):
+        # stale template (chain advanced / mempool GC'd / sync running):
+        # the reference miner absorbs all of these by refetching
+        import sys as _sys
+
+        fresh = (await (await client.get("/get_mining_info")).json())["result"]
+        print(f"mine_via_api retry: {res.get('error')!r}; template was "
+              f"id={last_block.get('id')} now "
+              f"id={fresh['last_block'].get('id')}", file=_sys.stderr)
         return await mine_via_api(client, address, _retried=True)
     return res
 
