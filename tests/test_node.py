@@ -151,7 +151,8 @@ async def mine_via_api(client: TestClient, address: str,
     if not res.get("ok") and not _retried and any(
             s in str(res.get("error", ""))
             for s in ("Transaction hash not found", "already syncing",
-                      "Too old block")):
+                      "Too old block", "Previous hash is not matched",
+                      "block not valid")):
         # stale template (chain advanced / mempool GC'd / sync running):
         # the reference miner absorbs all of these by refetching
         import sys as _sys

@@ -17,6 +17,7 @@ Rules and quirks are otherwise replicated exactly; citations inline.
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import time
 from decimal import Decimal
@@ -88,6 +89,12 @@ class BlockManager:
         # transient page-level signature verdicts (chain-sync prefill):
         # set by the node's create_blocks around a page's accept loop
         self.page_sig_verdicts: Optional[dict] = None
+        # one acceptance at a time: check_block suspends (sql, executor
+        # dispatch), so two concurrent push_block handlers could both
+        # validate against tip N and race the same block id into the
+        # insert — the loser must instead re-validate against the new
+        # tip and reject cleanly ("Previous hash is not matched")
+        self._accept_lock = asyncio.Lock()
 
     def invalidate_difficulty(self):
         self._difficulty_cache = None
@@ -237,9 +244,10 @@ class BlockManager:
         from ..trace import span
 
         errors = errors if errors is not None else []
-        with span("block_accept", level="info", txs=len(transactions)):
-            return await self._create_block_timed(
-                block_content, transactions, last_block, errors)
+        async with self._accept_lock:
+            with span("block_accept", level="info", txs=len(transactions)):
+                return await self._create_block_timed(
+                    block_content, transactions, last_block, errors)
 
     async def _create_block_timed(self, block_content, transactions,
                                   last_block, errors) -> bool:
@@ -328,6 +336,12 @@ class BlockManager:
         """Sync-time accept: trusts the embedded coinbase, skips the
         emission gate, still runs full check_block (manager.py:760-835)."""
         errors = errors if errors is not None else []
+        async with self._accept_lock:
+            return await self._create_block_syncing_locked(
+                block_content, transactions, coinbase, errors)
+
+    async def _create_block_syncing_locked(self, block_content, transactions,
+                                           coinbase, errors) -> bool:
         self.invalidate_difficulty()
         difficulty, last_block = await self.calculate_difficulty()
         block_no = (last_block["id"] + 1) if last_block else 1
