@@ -5,7 +5,7 @@
 set -u
 mkdir -p /tmp/tpuq
 cd /root/repo
-for i in $(seq 1 72); do
+for i in $(seq 1 60); do
   if timeout 100 python -c 'import jax; jax.devices()' >/dev/null 2>&1; then
     echo "$(date -u +%H:%M:%S) tunnel healthy, running queue" >> /tmp/tpuq/log
     timeout 3000 python -u .tpu_tile_ab.py > /tmp/tpuq/ab.out 2>/tmp/tpuq/ab.err
@@ -14,10 +14,14 @@ for i in $(seq 1 72); do
     echo "$(date -u +%H:%M:%S) c3 done rc=$?" >> /tmp/tpuq/log
     timeout 900 python bench.py > /tmp/tpuq/bench.out 2>/tmp/tpuq/bench.err
     echo "$(date -u +%H:%M:%S) bench done rc=$?" >> /tmp/tpuq/log
+    timeout 1200 python bench_suite.py --configs 2,5 --seconds 10 > /tmp/tpuq/c25.out 2>/tmp/tpuq/c25.err
+    echo "$(date -u +%H:%M:%S) c25 done rc=$?" >> /tmp/tpuq/log
+    timeout 1800 python bench_suite.py --configs 6 --seconds 5 > /tmp/tpuq/c6.out 2>/tmp/tpuq/c6.err
+    echo "$(date -u +%H:%M:%S) c6 done rc=$?" >> /tmp/tpuq/log
     exit 0
   fi
   echo "$(date -u +%H:%M:%S) tunnel down (probe $i)" >> /tmp/tpuq/log
   sleep 290
 done
-echo "gave up after 6h" >> /tmp/tpuq/log
+echo "gave up" >> /tmp/tpuq/log
 exit 1
