@@ -1,27 +1,39 @@
 #!/bin/bash
 # On-chip measurement queue: waits for the tunneled TPU to probe healthy,
-# then runs the pending A/Bs serially (the chip claim is exclusive per
-# process).  Results land in /tmp/tpuq/.
+# then runs the pending measurements serially (the chip claim is exclusive
+# per process).  Results land in /tmp/tpuq/; a successful bench.py run on
+# TPU also persists .last_good_tpu.json in the repo so the end-of-round
+# capture carries the freshest device number even through a later outage.
+# Loops for the whole session: after a successful queue pass it re-runs
+# bench.py every ~2 h while the chip stays healthy.
 set -u
 mkdir -p /tmp/tpuq
 cd /root/repo
-for i in $(seq 1 60); do
+ran_queue=0
+for i in $(seq 1 140); do
   if timeout 100 python -c 'import jax; jax.devices()' >/dev/null 2>&1; then
-    echo "$(date -u +%H:%M:%S) tunnel healthy, running queue" >> /tmp/tpuq/log
-    timeout 3000 python -u .tpu_tile_ab.py > /tmp/tpuq/ab.out 2>/tmp/tpuq/ab.err
-    echo "$(date -u +%H:%M:%S) ab done rc=$?" >> /tmp/tpuq/log
-    timeout 1200 python bench_suite.py --configs 3 --seconds 10 > /tmp/tpuq/c3.out 2>/tmp/tpuq/c3.err
-    echo "$(date -u +%H:%M:%S) c3 done rc=$?" >> /tmp/tpuq/log
-    timeout 900 python bench.py > /tmp/tpuq/bench.out 2>/tmp/tpuq/bench.err
-    echo "$(date -u +%H:%M:%S) bench done rc=$?" >> /tmp/tpuq/log
-    timeout 1200 python bench_suite.py --configs 2,5 --seconds 10 > /tmp/tpuq/c25.out 2>/tmp/tpuq/c25.err
-    echo "$(date -u +%H:%M:%S) c25 done rc=$?" >> /tmp/tpuq/log
-    timeout 1800 python bench_suite.py --configs 6 --seconds 5 > /tmp/tpuq/c6.out 2>/tmp/tpuq/c6.err
-    echo "$(date -u +%H:%M:%S) c6 done rc=$?" >> /tmp/tpuq/log
-    exit 0
+    if [ "$ran_queue" = 0 ]; then
+      echo "$(date -u +%H:%M:%S) tunnel healthy, running queue" >> /tmp/tpuq/log
+      timeout 900 python bench.py > /tmp/tpuq/bench.out 2>/tmp/tpuq/bench.err
+      echo "$(date -u +%H:%M:%S) bench done rc=$?" >> /tmp/tpuq/log
+      timeout 1200 python bench_suite.py --configs 3 --seconds 10 > /tmp/tpuq/c3.out 2>/tmp/tpuq/c3.err
+      echo "$(date -u +%H:%M:%S) c3 done rc=$?" >> /tmp/tpuq/log
+      timeout 1200 python bench_suite.py --configs 2,5 --seconds 10 > /tmp/tpuq/c25.out 2>/tmp/tpuq/c25.err
+      echo "$(date -u +%H:%M:%S) c25 done rc=$?" >> /tmp/tpuq/log
+      ran_queue=1
+      sleep 7200
+      continue
+    else
+      echo "$(date -u +%H:%M:%S) tunnel healthy, refreshing bench" >> /tmp/tpuq/log
+      timeout 900 python bench.py > /tmp/tpuq/bench_refresh.out 2>/tmp/tpuq/bench_refresh.err
+      echo "$(date -u +%H:%M:%S) refresh done rc=$?" >> /tmp/tpuq/log
+      sleep 7200
+      continue
+    fi
+  else
+    echo "$(date -u +%H:%M:%S) tunnel down (probe $i)" >> /tmp/tpuq/log
   fi
-  echo "$(date -u +%H:%M:%S) tunnel down (probe $i)" >> /tmp/tpuq/log
   sleep 290
 done
-echo "gave up" >> /tmp/tpuq/log
-exit 1
+echo "watcher loop done" >> /tmp/tpuq/log
+exit 0

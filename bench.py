@@ -28,6 +28,42 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 _CPU_CHILD_MARKER = "UPOW_BENCH_CPU_CHILD"
 
+# Freshest in-round TPU measurement, persisted so a later capture under a
+# tunnel outage still carries the real device number — timestamped and
+# clearly labeled, never silently substituted for the live value.
+_LAST_GOOD_TPU = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".last_good_tpu.json")
+
+
+def _record_last_good_tpu(result: dict) -> None:
+    import datetime
+
+    entry = dict(result)
+    entry["measured_at"] = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    try:
+        with open(_LAST_GOOD_TPU, "w") as f:
+            json.dump(entry, f)
+    except OSError:
+        pass
+
+
+def _load_last_good_tpu():
+    try:
+        with open(_LAST_GOOD_TPU) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _attach_last_good(result: dict) -> dict:
+    """On a non-TPU emission, attach the freshest persisted TPU
+    measurement (if any) under its own labeled key."""
+    last = _load_last_good_tpu()
+    if last is not None:
+        result["last_good_tpu"] = last
+    return result
+
 
 def _reexec_cpu_child() -> int:
     """Re-run this script in a scrubbed-env child pinned to XLA:CPU.
@@ -93,11 +129,11 @@ def main() -> int:
     if platform is None:
         if os.environ.get(_CPU_CHILD_MARKER):
             # even the clean CPU child failed: emit the honest zero line
-            print(json.dumps({
+            print(json.dumps(_attach_last_good({
                 "metric": "sha256_pow_search_none_none",
                 "value": 0.0, "unit": "MH/s", "vs_baseline": 0.0,
                 "error": "no jax backend available",
-            }))
+            })))
             return 0
         sys.stderr.write("falling back to scrubbed-env CPU child\n")
         return _reexec_cpu_child()
@@ -178,12 +214,20 @@ def main() -> int:
             mhs = rounds * args.batch / elapsed / 1e6
 
     baseline = _baseline_python_mhs(header.prefix_bytes())
-    print(json.dumps({
+    result = {
         "metric": f"sha256_pow_search_{backend}_{platform}",
         "value": round(mhs, 3),
         "unit": "MH/s",
         "vs_baseline": round(mhs / baseline, 1),
-    }))
+    }
+    if platform != "cpu" and backend in ("pallas", "jnp"):
+        # device measurement on a real chip — snapshot it.  Host-loop
+        # backends (--backend native/python) on the TPU host must NOT
+        # overwrite the device number.
+        _record_last_good_tpu(result)
+    elif platform == "cpu":
+        result = _attach_last_good(result)
+    print(json.dumps(result))
     return 0
 
 
@@ -194,9 +238,9 @@ if __name__ == "__main__":
         raise
     except BaseException as e:  # always leave a parseable line for the driver
         traceback.print_exc()
-        print(json.dumps({
+        print(json.dumps(_attach_last_good({
             "metric": "sha256_pow_search_error",
             "value": 0.0, "unit": "MH/s", "vs_baseline": 0.0,
             "error": f"{type(e).__name__}: {e}"[:300],
-        }))
+        })))
         raise SystemExit(0)

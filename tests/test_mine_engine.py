@@ -144,6 +144,8 @@ def test_supervisor_respawns_hung_child(tmp_path):
            if not k.startswith(("JAX_", "XLA_"))}
     env["JAX_PLATFORMS"] = "cpu"
     t0 = __import__("time").monotonic()
-    proc = subprocess.run([_sys.executable, str(stub)], env=env, timeout=60)
+    proc = subprocess.run([_sys.executable, str(stub)], env=env, timeout=60,
+                          capture_output=True, text=True)
     assert proc.returncode == 3
+    assert "no mining progress" in proc.stderr
     assert __import__("time").monotonic() - t0 < 30

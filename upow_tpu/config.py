@@ -90,10 +90,13 @@ class NodeConfig:
     response_cap: int = 20 * 1024 * 1024  # streaming response cap (:79-86)
     sync_reorg_window: int = 500    # main.py:167-185
     sync_page: int = 1000           # block download page (main.py:188-192)
-    sync_fetch_interval: float = 1.5  # min seconds between get_blocks
-                                    # fetches — stays under the peer's
-                                    # 40/min limit even with the
-                                    # pipelined next-page prefetch
+    sync_fetch_interval: float = 1.7  # min seconds between get_blocks
+                                    # fetches — the peer's limit is
+                                    # 40/min (one per 1.5 s); 1.7 s keeps
+                                    # headroom for clock jitter and the
+                                    # limiter's window alignment even
+                                    # with the pipelined next-page
+                                    # prefetch
     mempool_clean_interval: int = 600  # main.py:678-683
     rate_limits_enabled: bool = True   # slowapi parity (main.py:55)
 

@@ -83,7 +83,8 @@ def _g_table() -> list:
 def point_mul_G(k: int) -> Point:
     """k * G via the fixed-base window table (same result as
     ``point_mul(k, G)``)."""
-    if k % CURVE_N == 0:
+    k %= CURVE_N  # table only spans 256 bits; also handles oversized keys
+    if k == 0:
         return None
     table = _g_table()
     result: Point = None

@@ -107,6 +107,10 @@ def _start_hang_watchdog(heartbeat: dict, limit: float, _exit=None):
                 print(f"no mining progress for {lim:.0f}s — device hang? "
                       "exiting for respawn", file=sys.stderr, flush=True)
                 _exit(3)
+                # os._exit never returns; a test's substitute does — stop
+                # so the thread doesn't keep printing for the rest of the
+                # process lifetime
+                return
 
     t = threading.Thread(target=watch, daemon=True, name="miner-watchdog")
     t.start()

@@ -110,6 +110,7 @@ class Node:
         # out a 240 s device timeout.
         for task in list(self._background):
             task.cancel()
+        done, stragglers = set(), set()
         if self._background:
             done, stragglers = await asyncio.wait(
                 list(self._background), timeout=5.0)
@@ -119,6 +120,15 @@ class Node:
         if self._http_session is not None and not self._http_session.closed:
             await self._http_session.close()
         self.state.close()
+        # A straggler that resumes after state.close() (e.g. a sync that
+        # was blocked in the executor on a device verify) will hit
+        # "Cannot operate on a closed database"; retrieve its exception
+        # quietly instead of letting asyncio log it as never-retrieved.
+        # `done` members may also have errored while unwinding their
+        # cancellation (asyncio.wait never retrieves) — cover both.
+        for task in done | stragglers:
+            task.add_done_callback(
+                lambda t: t.cancelled() or t.exception())
 
     def _session(self):
         """Shared aiohttp session for all outbound RPC (one connection
@@ -951,7 +961,15 @@ class Node:
                             blocks = await fetch_page(i)
                     else:
                         if prefetch is not None:
+                            # retrieve the discarded fetch's outcome via a
+                            # callback, not an await: awaiting a task we
+                            # just cancelled is indistinguishable from our
+                            # OWN cancellation arriving at that suspension
+                            # point, and swallowing that would let sync
+                            # outlive close()
                             prefetch.cancel()
+                            prefetch.add_done_callback(
+                                lambda t: t.cancelled() or t.exception())
                         blocks = await fetch_page(i)
                     prefetch = None
                     if len(blocks) == cfg.sync_page:
@@ -992,11 +1010,12 @@ class Node:
             # unreachable: the loop exits only via the returns above
         finally:
             if prefetch is not None:
+                # same callback pattern as the mid-loop discard: never
+                # await a task we cancelled from inside a finally that
+                # may itself be unwinding a cancellation
                 prefetch.cancel()
-                try:
-                    await prefetch
-                except (asyncio.CancelledError, Exception):
-                    pass
+                prefetch.add_done_callback(
+                    lambda t: t.cancelled() or t.exception())
             await iface.close()
 
     async def create_blocks(self, blocks: list,

@@ -136,8 +136,15 @@ class ChainState:
     """One chain's durable state.  ``path=None`` -> in-memory (tests)."""
 
     def __init__(self, path: Optional[str] = None,
-                 device_index: bool = False):
+                 device_index: bool = False,
+                 sole_writer: bool = True):
         self.path = path or ":memory:"
+        # sole_writer=False (e.g. a wallet CLI reading a file the node is
+        # writing) disables the 50 ms rate limit on the data_version
+        # check: every memo read verifies no other connection committed,
+        # so a secondary reader never serves stale amounts/addresses into
+        # fee/coinbase computation.
+        self.sole_writer = sole_writer
         self.db = sqlite3.connect(self.path)
         self.db.row_factory = sqlite3.Row
         if path:
@@ -185,7 +192,7 @@ class ChainState:
         race ongoing commits by >=50 ms anyway.
         """
         now = _time.monotonic()
-        if now - self._data_version_checked >= 0.05:
+        if not self.sole_writer or now - self._data_version_checked >= 0.05:
             self._data_version_checked = now
             version = self._db_data_version()
             if version != self._data_version:

@@ -77,7 +77,10 @@ async def amain(argv=None) -> int:
     store = KeyStore(args.wallet)
     node_url = args.node or cfg.node.seed_url
     db_path = args.db if args.db is not None else cfg.node.db_path
-    state = ChainState(db_path) if db_path else None
+    # sole_writer=False: the node may be writing this file concurrently;
+    # pay the per-read data_version pragma instead of risking 50 ms of
+    # stale cached amounts (ADVICE r2).
+    state = ChainState(db_path, sole_writer=False) if db_path else None
 
     if args.command == "createwallet":
         d, address = store.create_key()
