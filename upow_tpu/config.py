@@ -77,7 +77,12 @@ class DeviceConfig:
 class NodeConfig:
     host: str = "0.0.0.0"
     port: int = 3006                # reference run_node.py port
+    db_backend: str = "sqlite"      # sqlite | postgres
     db_path: str = "upow_tpu.db"    # sqlite file ('' -> in-memory)
+    pg_dsn: str = ""                # postgres DSN (db_backend=postgres);
+                                    # reference ecosystem interop — point
+                                    # at an existing uPow database
+                                    # (db_setup.sh / schema.sql)
     seed_url: str = DEFAULT_SEED_URL
     peers_file: str = "nodes.json"
     ip_config_file: str = "ip_config.json"

@@ -64,8 +64,26 @@ class Node:
         self.config = config or Config()
         setup_logging(self.config.log)
         self.config.device.apply_kernel_overrides()
-        self.state = ChainState(self.config.node.db_path or None,
-                                device_index=self.config.device.utxo_index)
+        if self.config.node.db_backend == "postgres":
+            # reference-ecosystem interop: run against an existing uPow
+            # PostgreSQL database (schema.sql) via asyncpg
+            from ..state.pg import PgChainState
+
+            self.state = PgChainState(
+                self.config.node.pg_dsn,
+                # reference default sidecar filename (pickledb)
+                emission_path="emission_details.json")
+            self.state.ensure_schema()
+            if self.config.device.utxo_index:
+                self.state.enable_device_index()
+        elif self.config.node.db_backend == "sqlite":
+            self.state = ChainState(
+                self.config.node.db_path or None,
+                device_index=self.config.device.utxo_index)
+        else:
+            raise ValueError(
+                f"node.db_backend must be 'sqlite' or 'postgres', not"
+                f" {self.config.node.db_backend!r}")
         self.manager = BlockManager(
             self.state, sig_backend=self.config.device.sig_backend,
             verify_pad_block=self.config.device.verify_pad_block,

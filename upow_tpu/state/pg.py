@@ -1,0 +1,1007 @@
+"""PostgreSQL chain-state backend — drop-in interop with a reference DB.
+
+Implements the same storage seam as :class:`upow_tpu.state.storage.ChainState`
+(the consensus views are shared via :class:`upow_tpu.state.views.StateViews`)
+against the reference's EXACT schema (``/root/reference/schema.sql``,
+``database.py:33-91``): an operator can point this node at an existing
+uPow PostgreSQL database — or create a fresh one with
+:meth:`PgChainState.ensure_schema` — and reuse the reference ecosystem's
+tooling (db_setup.sh, makefile.postgres, create_unspent_outputs.py).
+
+Representation differences vs the sqlite backend, all absorbed here so
+the rest of the framework sees one API (int smallest-units, epoch ints):
+
+* output tables carry NO amount column — amounts resolve through
+  ``transactions.outputs_amounts`` (schema.sql:12-20), so every
+  amount-bearing read is a JOIN with the array indexed host-side,
+* ``fees``/``reward`` are NUMERIC(14,6) **coins** (quantized to 6 dp by
+  the column type — a reference-inherited representation limit; the
+  consensus-critical fee path recomputes from tx amounts and never
+  round-trips through these columns),
+* ``timestamp``/``propagation_time`` are TIMESTAMP(0) (naive UTC),
+* address arrays are TEXT[] (the sqlite backend stores JSON),
+* the outpoint index column is ``"index"`` (quoted — reserved-adjacent).
+
+The driver seam (state/pgdriver.py) keeps the SQL here runnable both on
+asyncpg (production) and on the sqlite-backed mock (CI without a
+server); see that module for the SQL-subset discipline.
+
+Not supported on this backend (documented divergences): the sqlite
+memo caches (every read hits the DB — correctness-first; the node's
+hot verify path batches at a higher level), and WAL-specific behaviors.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from contextlib import asynccontextmanager
+from decimal import Decimal
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+from ..core.clock import timestamp as now_ts
+from ..core.constants import SMALLEST
+from ..core.tx import CoinbaseTx, Tx, TxInput, tx_from_hex
+from .pgdriver import AsyncpgDriver, MockPgDriver, _epoch, _utc
+from .storage import _GOV_TABLES, _INPUT_TABLE, _OUTPUT_TABLE
+from .views import StateViews
+
+AnyTx = Union[Tx, CoinbaseTx]
+
+_COIN_Q = Decimal("0.000001")  # NUMERIC(14,6) quantum (schema.sql)
+
+# Reference schema.sql statements (schema.sql:1-84), one per entry so
+# ensure_schema can tolerate partially-created databases.
+PG_SCHEMA = [
+    """CREATE TABLE IF NOT EXISTS blocks (
+        id SERIAL PRIMARY KEY,
+        hash CHAR(64) UNIQUE,
+        content TEXT NOT NULL,
+        address VARCHAR(128) NOT NULL,
+        random BIGINT NOT NULL,
+        difficulty NUMERIC(3, 1) NOT NULL,
+        reward NUMERIC(14, 6) NOT NULL,
+        timestamp TIMESTAMP(0)
+    )""",
+    """CREATE TABLE IF NOT EXISTS transactions (
+        block_hash CHAR(64) NOT NULL REFERENCES blocks(hash) ON DELETE CASCADE,
+        tx_hash CHAR(64) UNIQUE,
+        tx_hex TEXT,
+        inputs_addresses TEXT[],
+        outputs_addresses TEXT[],
+        outputs_amounts BIGINT[],
+        fees NUMERIC(14, 6) NOT NULL
+    )""",
+    """CREATE TABLE IF NOT EXISTS unspent_outputs (
+        tx_hash CHAR(64) REFERENCES transactions(tx_hash) ON DELETE CASCADE,
+        index SMALLINT NOT NULL,
+        address TEXT NULL,
+        is_stake BOOLEAN
+    )""",
+    """CREATE TABLE IF NOT EXISTS pending_transactions (
+        tx_hash CHAR(64) UNIQUE,
+        tx_hex TEXT,
+        inputs_addresses TEXT[],
+        fees NUMERIC(14, 6) NOT NULL,
+        propagation_time TIMESTAMP(0) NOT NULL DEFAULT NOW()
+    )""",
+    """CREATE TABLE IF NOT EXISTS pending_spent_outputs (
+        tx_hash CHAR(64) REFERENCES transactions(tx_hash) ON DELETE CASCADE,
+        index SMALLINT NOT NULL
+    )""",
+] + [
+    f"""CREATE TABLE IF NOT EXISTS {t} (
+        tx_hash CHAR(64) REFERENCES transactions(tx_hash) ON DELETE CASCADE,
+        index SMALLINT NOT NULL,
+        address TEXT NULL
+    )"""
+    for t in _GOV_TABLES
+] + [
+    "CREATE INDEX IF NOT EXISTS tx_hash_idx ON unspent_outputs (tx_hash)",
+    "CREATE INDEX IF NOT EXISTS block_hash_idx ON transactions (block_hash)",
+]
+
+
+def _coins(units: int) -> Decimal:
+    """int smallest-units -> NUMERIC(14,6) coin value (quantized the way
+    the column would)."""
+    return (Decimal(units) / SMALLEST).quantize(_COIN_Q)
+
+
+def _units(coins: Optional[Decimal]) -> int:
+    return int(Decimal(coins or 0) * SMALLEST)
+
+
+class PgChainState(StateViews):
+    """ChainState-compatible storage over the reference PostgreSQL schema.
+
+    ``driver`` defaults to asyncpg on ``dsn``; tests inject
+    :class:`MockPgDriver`.
+    """
+
+    def __init__(self, dsn: str = "", driver=None,
+                 emission_path: Optional[str] = None):
+        self.drv = driver if driver is not None else AsyncpgDriver(dsn)
+        self.path = dsn
+        self.emission_path = emission_path
+        self._dev_index: Optional[Dict[str, object]] = None
+        self._in_atomic = False
+
+    @asynccontextmanager
+    async def _txn(self):
+        """Group a multi-statement mutation into one transaction unless
+        an outer atomic() already holds one.  The sqlite backend gets
+        this implicitly (sqlite3 defers commit until _commit()); with
+        per-statement autocommit a crash mid-reorg would otherwise leave
+        torn chain state."""
+        if self._in_atomic:
+            yield
+            return
+        self.drv.begin()
+        try:
+            yield
+            self.drv.commit()
+        except BaseException:
+            self.drv.rollback()
+            raise
+
+    def ensure_schema(self) -> None:
+        """Create any missing tables (idempotent; a pre-existing uPow
+        database passes through untouched)."""
+        for stmt in PG_SCHEMA:
+            self.drv.execute(stmt)
+
+    def close(self):
+        self.drv.close()
+
+    @asynccontextmanager
+    async def atomic(self):
+        """One transaction around a whole block acceptance (the driver
+        autocommits individual statements outside of this)."""
+        self._in_atomic = True
+        try:
+            self.drv.begin()
+            yield
+            self.drv.commit()
+        except BaseException:
+            self.drv.rollback()
+            self._index_rebuild()
+            raise
+        finally:
+            self._in_atomic = False
+
+    # ------------------------------------------------------ device index --
+
+    def enable_device_index(self) -> None:
+        """Same device-resident membership prefilter as the sqlite
+        backend (storage.py enable_device_index)."""
+        from ..benchutil import probed_platform_cached
+
+        if probed_platform_cached(timeout=90.0) is None:
+            import logging
+
+            logging.getLogger("upow_tpu.state").warning(
+                "jax backend init hung/failed; device UTXO index disabled")
+            self._dev_index = None
+            return
+        from .device_index import DeviceUtxoIndex
+
+        self._dev_index = {}
+        for table in ("unspent_outputs",) + _GOV_TABLES:
+            rows = self.drv.fetch(f'SELECT tx_hash, "index" FROM {table}')
+            self._dev_index[table] = DeviceUtxoIndex(
+                (r["tx_hash"], r["index"]) for r in rows)
+
+    def _index_add(self, table, outpoints):
+        if self._dev_index is not None:
+            self._dev_index[table].add(outpoints)
+
+    def _index_remove(self, table, outpoints):
+        if self._dev_index is not None:
+            self._dev_index[table].remove(outpoints)
+
+    def _index_rebuild(self):
+        if self._dev_index is not None:
+            self.enable_device_index()
+
+    # ------------------------------------------------------------- blocks --
+
+    async def add_block(self, block_id: int, block_hash: str, content: str,
+                        address: str, nonce: int, difficulty, reward: int,
+                        ts: int) -> None:
+        self.drv.execute(
+            "INSERT INTO blocks (id, hash, content, address, random,"
+            " difficulty, reward, timestamp) VALUES ($1,$2,$3,$4,$5,$6,$7,$8)",
+            (block_id, block_hash, content, address, nonce,
+             Decimal(str(difficulty)), _coins(reward), _utc(ts)),
+        )
+
+    @staticmethod
+    def _block_dict(r) -> dict:
+        return {
+            "id": r["id"],
+            "hash": r["hash"],
+            "content": r["content"],
+            "address": r["address"],
+            "random": r["random"],
+            "difficulty": Decimal(r["difficulty"]),
+            "reward": Decimal(r["reward"]),
+            "timestamp": _epoch(r["timestamp"]),
+        }
+
+    async def get_block(self, block_hash: str) -> Optional[dict]:
+        rows = self.drv.fetch(
+            "SELECT * FROM blocks WHERE hash = $1", (block_hash,))
+        return self._block_dict(rows[0]) if rows else None
+
+    async def get_block_by_id(self, block_id: int) -> Optional[dict]:
+        rows = self.drv.fetch(
+            "SELECT * FROM blocks WHERE id = $1", (block_id,))
+        return self._block_dict(rows[0]) if rows else None
+
+    async def get_last_block(self) -> Optional[dict]:
+        rows = self.drv.fetch("SELECT * FROM blocks ORDER BY id DESC LIMIT 1")
+        return self._block_dict(rows[0]) if rows else None
+
+    async def get_next_block_id(self) -> int:
+        rows = self.drv.fetch("SELECT MAX(id) AS m FROM blocks")
+        return (rows[0]["m"] or 0) + 1
+
+    async def get_blocks(self, offset: int, limit: int) -> List[dict]:
+        """Blocks with embedded full transactions (database.py:380-437)."""
+        rows = self.drv.fetch(
+            "SELECT * FROM blocks WHERE id >= $1 ORDER BY id LIMIT $2",
+            (offset, limit))
+        out = []
+        for r in rows:
+            txs = self.drv.fetch(
+                "SELECT tx_hex FROM transactions WHERE block_hash = $1",
+                (r["hash"],))
+            block = self._block_dict(r)
+            block["difficulty"] = float(block["difficulty"])
+            block["reward"] = str(block["reward"])
+            out.append({
+                "block": block,
+                "transactions": [t["tx_hex"] for t in txs],
+            })
+        return out
+
+    async def remove_blocks(self, from_block_id: int) -> None:
+        """Reorg rollback (database.py:146-169), same dependent-tx filter
+        as the sqlite backend."""
+        rows = self.drv.fetch(
+            "SELECT t.tx_hex FROM transactions t JOIN blocks b"
+            " ON t.block_hash = b.hash WHERE b.id >= $1", (from_block_id,))
+        txs = [tx_from_hex(r["tx_hex"], check_signatures=False) for r in rows]
+        created = [tx.hash() for tx in txs]
+        async with self._txn():
+            for table in ("unspent_outputs",) + _GOV_TABLES:
+                self.drv.executemany(
+                    f"DELETE FROM {table} WHERE tx_hash = $1",
+                    [(h,) for h in created])
+            created_set = set(created)
+            restore = [
+                tx_input for tx in txs if not tx.is_coinbase
+                for tx_input in tx.inputs
+                if tx_input.tx_hash not in created_set
+            ]
+            await self._restore_spent_outputs(restore)
+            self.drv.executemany(
+                "DELETE FROM transactions WHERE tx_hash = $1",
+                [(h,) for h in created])
+            self.drv.execute(
+                "DELETE FROM blocks WHERE id >= $1", (from_block_id,))
+        self._index_rebuild()
+
+    async def _restore_spent_outputs(self, inputs: List[TxInput]) -> None:
+        for tx_input in inputs:
+            src = await self.get_transaction(tx_input.tx_hash,
+                                             include_pending=False)
+            if src is None:
+                continue
+            out = src.outputs[tx_input.index]
+            table = _OUTPUT_TABLE[out.output_type]
+            exists = self.drv.fetch(
+                f'SELECT 1 AS x FROM {table} WHERE tx_hash = $1'
+                f' AND "index" = $2', (tx_input.tx_hash, tx_input.index))
+            if exists:
+                continue
+            if table == "unspent_outputs":
+                self.drv.execute(
+                    'INSERT INTO unspent_outputs (tx_hash, "index", address,'
+                    " is_stake) VALUES ($1,$2,$3,$4)",
+                    (tx_input.tx_hash, tx_input.index, out.address,
+                     bool(out.is_stake)))
+            else:
+                self.drv.execute(
+                    f'INSERT INTO {table} (tx_hash, "index", address)'
+                    " VALUES ($1,$2,$3)",
+                    (tx_input.tx_hash, tx_input.index, out.address))
+
+    # ------------------------------------------------------- transactions --
+
+    async def add_transactions(self, txs: Sequence[AnyTx],
+                               block_hash: str) -> None:
+        rows = []
+        for tx in txs:
+            inputs_addresses = [] if tx.is_coinbase else [
+                await self.resolve_output_address(i.tx_hash, i.index) or ""
+                for i in tx.inputs
+            ]
+            fees = 0 if tx.is_coinbase else await self.tx_fees(tx)
+            rows.append((
+                block_hash, tx.hash(), tx.hex(),
+                inputs_addresses,
+                [o.address for o in tx.outputs],
+                [o.amount for o in tx.outputs],
+                _coins(fees),
+            ))
+        self.drv.executemany(
+            "INSERT INTO transactions (block_hash, tx_hash, tx_hex,"
+            " inputs_addresses, outputs_addresses, outputs_amounts, fees)"
+            " VALUES ($1,$2,$3,$4,$5,$6,$7)"
+            " ON CONFLICT (tx_hash) DO UPDATE SET block_hash ="
+            " EXCLUDED.block_hash", rows)
+
+    async def get_transaction(self, tx_hash: str,
+                              include_pending: bool = False) -> Optional[AnyTx]:
+        rows = self.drv.fetch(
+            "SELECT tx_hex FROM transactions WHERE tx_hash = $1", (tx_hash,))
+        if not rows and include_pending:
+            rows = self.drv.fetch(
+                "SELECT tx_hex FROM pending_transactions WHERE tx_hash = $1",
+                (tx_hash,))
+        return tx_from_hex(rows[0]["tx_hex"], check_signatures=False) \
+            if rows else None
+
+    async def get_transaction_info(self, tx_hash: str) -> Optional[dict]:
+        rows = self.drv.fetch(
+            "SELECT * FROM transactions WHERE tx_hash = $1", (tx_hash,))
+        if not rows:
+            return None
+        r = rows[0]
+        return {
+            "block_hash": r["block_hash"],
+            "tx_hash": r["tx_hash"],
+            "tx_hex": r["tx_hex"],
+            "inputs_addresses": list(r["inputs_addresses"]),
+            "outputs_addresses": list(r["outputs_addresses"]),
+            "outputs_amounts": list(r["outputs_amounts"]),
+            "fees": _units(r["fees"]),
+        }
+
+    async def get_block_transactions(self, block_hash: str,
+                                     hex_only: bool = False) -> List:
+        rows = self.drv.fetch(
+            "SELECT tx_hex FROM transactions WHERE block_hash = $1",
+            (block_hash,))
+        if hex_only:
+            return [r["tx_hex"] for r in rows]
+        return [tx_from_hex(r["tx_hex"], check_signatures=False) for r in rows]
+
+    async def resolve_output_address(self, tx_hash: str,
+                                     index: int) -> Optional[str]:
+        rows = self.drv.fetch(
+            "SELECT outputs_addresses FROM transactions WHERE tx_hash = $1",
+            (tx_hash,))
+        if rows:
+            addresses = list(rows[0]["outputs_addresses"])
+            return addresses[index] if index < len(addresses) else None
+        rows = self.drv.fetch(
+            "SELECT tx_hex FROM pending_transactions WHERE tx_hash = $1",
+            (tx_hash,))
+        if not rows:
+            return None
+        tx = tx_from_hex(rows[0]["tx_hex"], check_signatures=False)
+        return tx.outputs[index].address if index < len(tx.outputs) else None
+
+    async def get_output_amount(self, tx_hash: str,
+                                index: int) -> Optional[int]:
+        rows = self.drv.fetch(
+            "SELECT outputs_amounts FROM transactions WHERE tx_hash = $1",
+            (tx_hash,))
+        if rows:
+            amounts = list(rows[0]["outputs_amounts"])
+            return amounts[index] if index < len(amounts) else None
+        rows = self.drv.fetch(
+            "SELECT tx_hex FROM pending_transactions WHERE tx_hash = $1",
+            (tx_hash,))
+        if not rows:
+            return None
+        tx = tx_from_hex(rows[0]["tx_hex"], check_signatures=False)
+        return tx.outputs[index].amount if index < len(tx.outputs) else None
+
+    # ------------------------------------------------------------ mempool --
+
+    async def add_pending_transaction(self, tx: Tx) -> None:
+        inputs_addresses = [
+            await self.resolve_output_address(i.tx_hash, i.index) or ""
+            for i in tx.inputs
+        ]
+        fees = await self.tx_fees(tx)
+        async with self._txn():
+            self.drv.execute(
+                "INSERT INTO pending_transactions (tx_hash, tx_hex,"
+                " inputs_addresses, fees, propagation_time)"
+                " VALUES ($1,$2,$3,$4,$5)",
+                (tx.hash(), tx.hex(), inputs_addresses, _coins(fees),
+                 _utc(now_ts())))
+            self.drv.executemany(
+                'INSERT INTO pending_spent_outputs (tx_hash, "index")'
+                " VALUES ($1,$2)",
+                [(i.tx_hash, i.index) for i in tx.inputs])
+
+    def _pending_decoded(self) -> Dict[str, Tx]:
+        rows = self.drv.fetch(
+            "SELECT tx_hash, tx_hex FROM pending_transactions")
+        return {
+            r["tx_hash"]: tx_from_hex(r["tx_hex"], check_signatures=False)
+            for r in rows
+        }
+
+    async def pending_transaction_exists(self, tx_hash: str) -> bool:
+        return bool(self.drv.fetch(
+            "SELECT 1 AS x FROM pending_transactions WHERE tx_hash = $1",
+            (tx_hash,)))
+
+    async def get_pending_transactions_limit(
+        self, limit_hex_chars: int = 4096 * 1024, hex_only: bool = False
+    ) -> List:
+        """Fee-rate-ordered mempool slice capped by total hex size
+        (database.py:171-186).
+
+        Ordering reads the NUMERIC(14,6) fees column, so fee rates are
+        quantized to 100-smallest-unit granularity — EXACTLY what the
+        reference node does with this schema (its ORDER BY reads the
+        same lossy column).  The sqlite backend orders by exact integer
+        fees; a pg-backed node reproduces the reference's block-building
+        choices instead.  Consensus is unaffected (fees in accepted
+        blocks are recomputed from tx amounts)."""
+        rows = self.drv.fetch(
+            "SELECT tx_hex FROM pending_transactions ORDER BY"
+            " fees / LENGTH(tx_hex) DESC, tx_hash")
+        out, total = [], 0
+        for r in rows:
+            if total + len(r["tx_hex"]) > limit_hex_chars:
+                break
+            total += len(r["tx_hex"])
+            out.append(r["tx_hex"])
+        if hex_only:
+            return out
+        return [tx_from_hex(h, check_signatures=False) for h in out]
+
+    async def get_pending_transactions_by_hash(self,
+                                               hashes: List[str]) -> List[str]:
+        out = []
+        for h in hashes:
+            rows = self.drv.fetch(
+                "SELECT tx_hex FROM pending_transactions WHERE tx_hash = $1",
+                (h,))
+            if rows:
+                out.append(rows[0]["tx_hex"])
+        return out
+
+    async def get_pending_spent_outpoints(self) -> set:
+        rows = self.drv.fetch(
+            'SELECT tx_hash, "index" FROM pending_spent_outputs')
+        return {(r["tx_hash"], r["index"]) for r in rows}
+
+    async def remove_pending_transactions_by_hash(self,
+                                                  hashes: List[str]) -> None:
+        async with self._txn():
+            await self._remove_pending_by_hash_locked(hashes)
+
+    async def _remove_pending_by_hash_locked(self, hashes: List[str]) -> None:
+        for i in range(0, len(hashes), 500):
+            chunk = hashes[i:i + 500]
+            ph = ",".join(f"${j + 1}" for j in range(len(chunk)))
+            rows = self.drv.fetch(
+                "SELECT tx_hex FROM pending_transactions"
+                f" WHERE tx_hash IN ({ph})", chunk)
+            spent = []
+            for r in rows:
+                tx = tx_from_hex(r["tx_hex"], check_signatures=False)
+                if not tx.is_coinbase:
+                    spent.extend((inp.tx_hash, inp.index) for inp in tx.inputs)
+            if spent:
+                self.drv.executemany(
+                    "DELETE FROM pending_spent_outputs"
+                    ' WHERE tx_hash = $1 AND "index" = $2', spent)
+            self.drv.execute(
+                f"DELETE FROM pending_transactions WHERE tx_hash IN ({ph})",
+                chunk)
+
+    async def remove_pending_transactions(self) -> None:
+        async with self._txn():
+            self.drv.execute("DELETE FROM pending_transactions")
+            self.drv.execute("DELETE FROM pending_spent_outputs")
+
+    async def get_pending_transactions_count(self) -> int:
+        return self.drv.fetch(
+            "SELECT COUNT(*) AS c FROM pending_transactions")[0]["c"]
+
+    async def get_need_propagate_transactions(self,
+                                              older_than: int = 300) -> List[str]:
+        """Piggyback re-propagation queue (database.py:188-207)."""
+        rows = self.drv.fetch(
+            "SELECT tx_hex FROM pending_transactions"
+            " WHERE propagation_time < $1",
+            (_utc(now_ts() - older_than),))
+        return [r["tx_hex"] for r in rows]
+
+    async def update_pending_transaction_propagation(self,
+                                                     tx_hash: str) -> None:
+        self.drv.execute(
+            "UPDATE pending_transactions SET propagation_time = $1"
+            " WHERE tx_hash = $2", (_utc(now_ts()), tx_hash))
+
+    # --------------------------------------------------------------- UTXO --
+
+    async def add_transaction_outputs(self, txs: Sequence[AnyTx]) -> None:
+        """Route outputs into their UTXO-class table (database.py:524-580).
+        Delete-then-insert emulates the sqlite backend's REPLACE — the
+        reference tables have no outpoint uniqueness constraint.  Grouped
+        into one executemany per table so an 8k-tx block costs a handful
+        of driver round trips, not one per output."""
+        by_table: Dict[str, list] = {}
+        for tx in txs:
+            h = tx.hash()
+            for index, out in enumerate(tx.outputs):
+                table = _OUTPUT_TABLE[out.output_type]
+                by_table.setdefault(table, []).append((h, index, out))
+        for table, entries in by_table.items():
+            self.drv.executemany(
+                f'DELETE FROM {table} WHERE tx_hash = $1 AND "index" = $2',
+                [(h, i) for h, i, _ in entries])
+            if table == "unspent_outputs":
+                self.drv.executemany(
+                    'INSERT INTO unspent_outputs (tx_hash, "index",'
+                    " address, is_stake) VALUES ($1,$2,$3,$4)",
+                    [(h, i, o.address, bool(o.is_stake))
+                     for h, i, o in entries])
+            else:
+                self.drv.executemany(
+                    f'INSERT INTO {table} (tx_hash, "index", address)'
+                    " VALUES ($1,$2,$3)",
+                    [(h, i, o.address) for h, i, o in entries])
+            self._index_add(table, [(h, i) for h, i, _ in entries])
+
+    async def remove_outputs(self, txs: Sequence[AnyTx]) -> None:
+        """Spend inputs from the table their tx type targets
+        (database.py:589-622)."""
+        for tx in txs:
+            if tx.is_coinbase:
+                continue
+            table = _INPUT_TABLE.get(tx.transaction_type, "unspent_outputs")
+            self.drv.executemany(
+                f'DELETE FROM {table} WHERE tx_hash = $1 AND "index" = $2',
+                [(i.tx_hash, i.index) for i in tx.inputs])
+            self._index_remove(table, [i.outpoint for i in tx.inputs])
+
+    async def get_unspent_outpoints(self,
+                                    table: str = "unspent_outputs") -> set:
+        rows = self.drv.fetch(f'SELECT tx_hash, "index" FROM {table}')
+        return {(r["tx_hash"], r["index"]) for r in rows}
+
+    async def outpoints_exist(self, outpoints: List[Tuple[str, int]],
+                              table: str = "unspent_outputs") -> List[bool]:
+        """Batched membership test, same shape as the sqlite backend's
+        (storage.py outpoints_exist), device prefilter included."""
+        if not outpoints:
+            return []
+        if self._dev_index is not None and table in self._dev_index:
+            maybe = self._dev_index[table].maybe_contains_batch(
+                [tuple(o) for o in outpoints])
+            escalate = [o for o, m in zip(outpoints, maybe) if m]
+            confirmed = iter(await self._outpoints_exist_sql(escalate, table))
+            return [bool(m) and next(confirmed) for m in maybe]
+        return await self._outpoints_exist_sql(outpoints, table)
+
+    async def _outpoints_exist_sql(self, outpoints, table) -> List[bool]:
+        if not outpoints:
+            return []
+        found: set = set()
+        CHUNK = 400
+        for off in range(0, len(outpoints), CHUNK):
+            chunk = outpoints[off:off + CHUNK]
+            placeholders = ",".join(
+                f"(${2 * j + 1},${2 * j + 2})" for j in range(len(chunk)))
+            params = [v for o in chunk for v in o]
+            rows = self.drv.fetch(
+                f'SELECT tx_hash, "index" FROM {table} WHERE'
+                f' (tx_hash, "index") IN (VALUES {placeholders})', params)
+            found.update((r["tx_hash"], r["index"]) for r in rows)
+        return [tuple(o) in found for o in outpoints]
+
+    async def get_table_outpoints_hash(self, table: str) -> str:
+        rows = self.drv.fetch(
+            f'SELECT tx_hash, "index" FROM {table}'
+            ' ORDER BY tx_hash, "index"')
+        h = hashlib.sha256()
+        for r in rows:
+            h.update(f"{r['tx_hash']}{r['index']}".encode())
+        return h.hexdigest()
+
+    # ------------------------------------------------------ address views --
+
+    async def _amounts_for(self, rows) -> List[dict]:
+        """Attach amounts to outpoint rows carrying outputs_amounts
+        arrays (the reference's join-based amount resolution)."""
+        out = []
+        for r in rows:
+            amounts = list(r["outputs_amounts"] or [])
+            idx = r["index"]
+            out.append({
+                "tx_hash": r["tx_hash"], "index": idx,
+                "address": r["address"],
+                "amount": amounts[idx] if idx < len(amounts) else 0,
+            })
+        return out
+
+    async def get_spendable_outputs(self, address: str,
+                                    check_pending_txs: bool = False) -> List[TxInput]:
+        rows = self.drv.fetch(
+            'SELECT u.tx_hash, u."index", u.address, u.is_stake,'
+            " t.outputs_amounts FROM unspent_outputs u"
+            " JOIN transactions t ON t.tx_hash = u.tx_hash"
+            " WHERE u.address = $1 AND u.is_stake = $2", (address, False))
+        pending = (await self.get_pending_spent_outpoints()) \
+            if check_pending_txs else set()
+        out = []
+        for r in await self._amounts_for(rows):
+            if (r["tx_hash"], r["index"]) in pending:
+                continue
+            i = TxInput(r["tx_hash"], r["index"])
+            i.amount = r["amount"]
+            out.append(i)
+        return out
+
+    async def get_stake_outputs(self, address: str,
+                                check_pending_txs: bool = False) -> List[TxInput]:
+        rows = self.drv.fetch(
+            'SELECT u.tx_hash, u."index", u.address, u.is_stake,'
+            " t.outputs_amounts FROM unspent_outputs u"
+            " JOIN transactions t ON t.tx_hash = u.tx_hash"
+            " WHERE u.address = $1 AND u.is_stake = $2", (address, True))
+        pending = (await self.get_pending_spent_outpoints()) \
+            if check_pending_txs else set()
+        out = []
+        for r in await self._amounts_for(rows):
+            if (r["tx_hash"], r["index"]) in pending:
+                continue
+            i = TxInput(r["tx_hash"], r["index"])
+            i.amount = r["amount"]
+            out.append(i)
+        return out
+
+    async def get_address_transactions(self, address: str, limit: int = 50,
+                                       offset: int = 0) -> List[dict]:
+        rows = self.drv.fetch(
+            "SELECT t.tx_hash, b.id AS block_id FROM transactions t"
+            " JOIN blocks b ON b.hash = t.block_hash"
+            " WHERE $1 = ANY(inputs_addresses)"
+            " OR $1 = ANY(outputs_addresses)"
+            " ORDER BY b.id DESC LIMIT $2 OFFSET $3",
+            (address, limit, offset))
+        return [dict(r) for r in rows]
+
+    # --------------------------------------------------------- governance --
+
+    async def get_registered(self, table: str,
+                             check_pending_txs: bool = False,
+                             pending: Optional[set] = None) -> List[Tuple[str, int]]:
+        """(address, registered_at block timestamp) per registration
+        output (same contract as storage.py get_registered)."""
+        rows = self.drv.fetch(
+            f'SELECT g.tx_hash, g."index", g.address, b.timestamp AS ts'
+            f" FROM {table} g"
+            " LEFT JOIN transactions t ON t.tx_hash = g.tx_hash"
+            " LEFT JOIN blocks b ON b.hash = t.block_hash")
+        if pending is None:
+            pending = (await self.get_pending_spent_outpoints()) \
+                if check_pending_txs else set()
+        out = []
+        for r in rows:
+            if (r["tx_hash"], r["index"]) in pending:
+                continue
+            out.append((r["address"],
+                        _epoch(r["ts"]) if r["ts"] is not None else now_ts()))
+        return out
+
+    async def get_ballot_by_recipient(self, table: str, recipient: str,
+                                      check_pending_txs: bool = False) -> List[dict]:
+        """Standing votes FOR ``recipient`` (storage.py
+        get_ballot_by_recipient contract; reference database.py:939-1063)."""
+        rows = self.drv.fetch(
+            f'SELECT g.tx_hash, g."index", t.outputs_amounts,'
+            f" t.inputs_addresses FROM {table} g"
+            f" JOIN transactions t ON t.tx_hash = g.tx_hash"
+            f" WHERE g.address = $1", (recipient,))
+        pending = (await self.get_pending_spent_outpoints()) \
+            if check_pending_txs else set()
+        out = []
+        for r in rows:
+            if (r["tx_hash"], r["index"]) in pending:
+                continue
+            addrs = list(r["inputs_addresses"])
+            amounts = list(r["outputs_amounts"])
+            idx = r["index"]
+            out.append({
+                "tx_hash": r["tx_hash"], "index": idx,
+                "voter": addrs[idx] if idx < len(addrs) else None,
+                "vote": Decimal(amounts[idx] if idx < len(amounts) else 0)
+                / SMALLEST,
+            })
+        return out
+
+    async def _all_ballot_rows(self, table: str,
+                               check_pending_txs: bool = False,
+                               pending: Optional[set] = None) -> List[dict]:
+        rows = self.drv.fetch(
+            f'SELECT g.tx_hash, g."index", g.address AS recipient,'
+            f" t.outputs_amounts, t.inputs_addresses FROM {table} g"
+            f" JOIN transactions t ON t.tx_hash = g.tx_hash")
+        if pending is None:
+            pending = (await self.get_pending_spent_outpoints()) \
+                if check_pending_txs else set()
+        out = []
+        for r in rows:
+            if (r["tx_hash"], r["index"]) in pending:
+                continue
+            addrs = list(r["inputs_addresses"])
+            amounts = list(r["outputs_amounts"])
+            idx = r["index"]
+            out.append({
+                "tx_hash": r["tx_hash"], "index": idx,
+                "recipient": r["recipient"],
+                "voter": addrs[idx] if idx < len(addrs) else None,
+                "vote": Decimal(amounts[idx] if idx < len(amounts) else 0)
+                / SMALLEST,
+            })
+        return out
+
+    async def _outpoint_listing(self, table: str, address: str,
+                                check_pending_txs: bool) -> List[Tuple[str, int]]:
+        rows = self.drv.fetch(
+            f'SELECT tx_hash, "index" FROM {table} WHERE address = $1',
+            (address,))
+        pending = (await self.get_pending_spent_outpoints()) \
+            if check_pending_txs else set()
+        return [(r["tx_hash"], r["index"]) for r in rows
+                if (r["tx_hash"], r["index"]) not in pending]
+
+    async def get_delegates_voting_power(self, address: str,
+                                         check_pending_txs: bool = False) -> List[Tuple[str, int]]:
+        return await self._outpoint_listing(
+            "delegates_voting_power", address, check_pending_txs)
+
+    async def get_inode_registration_outputs(self, address: str,
+                                             check_pending_txs: bool = False) -> List[Tuple[str, int]]:
+        return await self._outpoint_listing(
+            "inode_registration_output", address, check_pending_txs)
+
+    async def get_validators_voting_power(self, address: str,
+                                          check_pending_txs: bool = False) -> List[Tuple[str, int]]:
+        return await self._outpoint_listing(
+            "validators_voting_power", address, check_pending_txs)
+
+    async def get_multiple_address_stakes(
+            self, addresses: Iterable[str],
+            check_pending_txs: bool = False,
+            pending: Optional[set] = None) -> Dict[str, Decimal]:
+        """Batch stake query (database.py:1208-1290)."""
+        addresses = list(set(addresses))
+        if not addresses:
+            return {}
+        out: Dict[str, Decimal] = {a: Decimal(0) for a in addresses}
+        placeholders = ",".join(f"${i + 1}" for i in range(len(addresses)))
+        rows = self.drv.fetch(
+            'SELECT u.tx_hash, u."index", u.address, t.outputs_amounts'
+            " FROM unspent_outputs u JOIN transactions t"
+            " ON t.tx_hash = u.tx_hash"
+            f" WHERE u.is_stake = ${len(addresses) + 1}"
+            f" AND u.address IN ({placeholders})",
+            list(addresses) + [True])
+        if pending is None:
+            pending = (await self.get_pending_spent_outpoints()) \
+                if check_pending_txs else set()
+        for r in await self._amounts_for(rows):
+            if (r["tx_hash"], r["index"]) in pending:
+                continue
+            out[r["address"]] += Decimal(r["amount"]) / SMALLEST
+        if check_pending_txs:
+            want = set(addresses)
+            for tx in self._pending_decoded().values():
+                for o in tx.outputs:
+                    if o.is_stake and o.address in want:
+                        out[o.address] += Decimal(o.amount) / SMALLEST
+        return out
+
+    async def get_outputs_by_address(self, table: str, address: str,
+                                     check_pending_txs: bool = False,
+                                     is_stake: Optional[bool] = None) -> List[dict]:
+        sql = (f'SELECT g.tx_hash, g."index", g.address, t.outputs_amounts'
+               + (", g.is_stake" if table == "unspent_outputs" else "")
+               + f" FROM {table} g JOIN transactions t"
+               " ON t.tx_hash = g.tx_hash WHERE g.address = $1")
+        params: list = [address]
+        if is_stake is not None and table == "unspent_outputs":
+            sql += " AND g.is_stake = $2"
+            params.append(bool(is_stake))
+        rows = self.drv.fetch(sql, params)
+        pending = (await self.get_pending_spent_outpoints()) \
+            if check_pending_txs else set()
+        return [
+            {"tx_hash": r["tx_hash"], "index": r["index"],
+             "amount": r["amount"]}
+            for r in await self._amounts_for(rows)
+            if (r["tx_hash"], r["index"]) not in pending
+        ]
+
+    async def get_ballots(self, table: str, recipient: Optional[str] = None,
+                          offset: int = 0, limit: int = 100) -> List[dict]:
+        """Paged ballot listing (storage.py get_ballots contract)."""
+        if recipient is not None:
+            rows = self.drv.fetch(
+                f'SELECT g.tx_hash, g."index", g.address,'
+                f" t.outputs_amounts, t.inputs_addresses FROM {table} g"
+                f" JOIN transactions t ON t.tx_hash = g.tx_hash"
+                f' WHERE g.address = $1 ORDER BY g.tx_hash, g."index"'
+                f" LIMIT $2 OFFSET $3",
+                (recipient, limit, offset))
+        else:
+            rows = self.drv.fetch(
+                f'SELECT g.tx_hash, g."index", g.address,'
+                f" t.outputs_amounts, t.inputs_addresses FROM {table} g"
+                f" JOIN transactions t ON t.tx_hash = g.tx_hash"
+                f' ORDER BY g.tx_hash, g."index" LIMIT $1 OFFSET $2',
+                (limit, offset))
+        out = []
+        for r in rows:
+            addrs = list(r["inputs_addresses"])
+            amounts = list(r["outputs_amounts"])
+            idx = r["index"]
+            out.append({
+                "tx_hash": r["tx_hash"], "index": idx,
+                "voter": addrs[idx] if idx < len(addrs) else None,
+                "recipient": r["address"],
+                "vote": Decimal(amounts[idx] if idx < len(amounts) else 0)
+                / SMALLEST,
+            })
+        return out
+
+    async def get_transaction_block_timestamp(self,
+                                              tx_hash: str) -> Optional[int]:
+        rows = self.drv.fetch(
+            "SELECT b.timestamp AS ts FROM transactions t JOIN blocks b ON"
+            " b.hash = t.block_hash WHERE t.tx_hash = $1", (tx_hash,))
+        return _epoch(rows[0]["ts"]) if rows else None
+
+    # ---------------------------------------------------- explorer views --
+
+    async def get_nice_transaction(self, tx_hash: str,
+                                   address: Optional[str] = None) -> Optional[dict]:
+        """Explorer-style decoded transaction (storage.py
+        get_nice_transaction contract; reference database.py:1606-1654)."""
+        rows = self.drv.fetch(
+            "SELECT t.tx_hash, t.tx_hex, t.inputs_addresses, t.block_hash,"
+            " b.id AS block_no, b.timestamp AS block_ts FROM"
+            " transactions t JOIN blocks b ON b.hash = t.block_hash"
+            " WHERE t.tx_hash = $1", (tx_hash,))
+        is_confirm = bool(rows)
+        if not rows:
+            rows = self.drv.fetch(
+                "SELECT tx_hash, tx_hex, inputs_addresses FROM"
+                " pending_transactions WHERE tx_hash = $1", (tx_hash,))
+        if not rows:
+            return None
+        r = rows[0]
+        keys = _row_keys(r)
+        tx = tx_from_hex(r["tx_hex"], check_signatures=False)
+        inputs_addresses = list(r["inputs_addresses"])
+
+        def coins(amount: int) -> float:
+            return float(Decimal(amount) / SMALLEST)
+
+        block_ts = _epoch(r["block_ts"]) if "block_ts" in keys else None
+        if tx.is_coinbase:
+            out = {
+                "is_coinbase": True, "hash": r["tx_hash"],
+                "block_hash": r["block_hash"] if "block_hash" in keys else None,
+                "block_no": r["block_no"] if "block_no" in keys else None,
+                "datetime": block_ts,
+            }
+        else:
+            delta = None
+            if address is not None:
+                delta = 0
+                for i, tx_input in enumerate(tx.inputs):
+                    if i < len(inputs_addresses) and inputs_addresses[i] == address:
+                        amt = await self.get_output_amount(
+                            tx_input.tx_hash, tx_input.index)
+                        delta -= amt or 0
+                for o in tx.outputs:
+                    if o.address == address:
+                        delta += o.amount
+                delta = coins(delta)
+            inputs = []
+            for i, tx_input in enumerate(tx.inputs):
+                amt = await self.get_output_amount(
+                    tx_input.tx_hash, tx_input.index)
+                inputs.append({
+                    "index": tx_input.index,
+                    "tx_hash": tx_input.tx_hash,
+                    "address": (inputs_addresses[i]
+                                if i < len(inputs_addresses) else None),
+                    "amount": coins(amt or 0),
+                })
+            out = {
+                "is_coinbase": False, "hash": r["tx_hash"],
+                "block_hash": r["block_hash"] if "block_hash" in keys else None,
+                "block_no": r["block_no"] if "block_no" in keys else None,
+                "datetime": block_ts,
+                "message": tx.message.hex() if tx.message is not None else None,
+                "transaction_type": tx.transaction_type.name,
+                "is_confirm": is_confirm,
+                "inputs": inputs,
+                "delta": delta,
+                "fees": coins(await self.tx_fees(tx)),
+            }
+        out["outputs"] = [
+            {"address": o.address, "amount": coins(o.amount),
+             "type": o.output_type.name}
+            for o in tx.outputs
+        ]
+        return out
+
+    async def get_block_transaction_hashes(self, block_hash: str) -> List[str]:
+        rows = self.drv.fetch(
+            "SELECT tx_hash FROM transactions WHERE block_hash = $1",
+            (block_hash,))
+        return [r["tx_hash"] for r in rows]
+
+    async def get_address_pending_transactions(self, address: str) -> List[Tx]:
+        rows = self.drv.fetch(
+            "SELECT tx_hex, inputs_addresses FROM pending_transactions")
+        out = []
+        for r in rows:
+            tx = tx_from_hex(r["tx_hex"], check_signatures=False)
+            if address in list(r["inputs_addresses"]) or \
+                    any(o.address == address for o in tx.outputs):
+                out.append(tx)
+        return out
+
+    async def get_address_pending_spent_outpoints(
+            self, address: str) -> List[Tuple[str, int]]:
+        rows = self.drv.fetch(
+            "SELECT tx_hex, inputs_addresses FROM pending_transactions")
+        out = []
+        for r in rows:
+            addrs = list(r["inputs_addresses"])
+            tx = tx_from_hex(r["tx_hex"], check_signatures=False)
+            for i, tx_input in enumerate(tx.inputs):
+                if i < len(addrs) and addrs[i] == address:
+                    out.append((tx_input.tx_hash, tx_input.index))
+        return out
+
+    # ----------------------------------------------------------- rebuild --
+
+    async def rebuild_utxos(self) -> None:
+        """Full-chain replay of every output table from the transactions
+        log (reference create_unspent_outputs.py + database.py:846-862)."""
+        async with self._txn():
+            for table in ("unspent_outputs",) + _GOV_TABLES:
+                self.drv.execute(f"DELETE FROM {table}")
+            rows = self.drv.fetch(
+                "SELECT t.tx_hex FROM transactions t JOIN blocks b ON"
+                " b.hash = t.block_hash ORDER BY b.id")
+            txs = [tx_from_hex(r["tx_hex"], check_signatures=False)
+                   for r in rows]
+            for tx in txs:
+                await self.add_transaction_outputs([tx])
+                await self.remove_outputs([tx])
+        self._index_rebuild()
+
+
+def _row_keys(r) -> set:
+    """Column names of a driver row (asyncpg Record or mock dict)."""
+    return set(r.keys())

@@ -1,0 +1,259 @@
+"""Drivers for the PostgreSQL chain-state backend (state/pg.py).
+
+Two implementations of one small synchronous facade:
+
+* :class:`AsyncpgDriver` — production: asyncpg (the reference's own
+  driver, database.py:33-91) behind a dedicated event-loop thread, so
+  the storage layer keeps the same short-synchronous-call model the
+  sqlite backend uses.  asyncpg is imported lazily: it is not part of
+  this framework's baseline dependencies and only needed when an
+  operator points the node at a PostgreSQL uPow database.
+
+* :class:`MockPgDriver` — tests: executes the same pg-dialect SQL
+  against stdlib sqlite, translating ``$n`` placeholders and the
+  handful of type-representation differences (TEXT[]/BIGINT[] arrays,
+  NUMERIC, TIMESTAMP, BOOLEAN).  This is what lets the PgChainState SQL
+  and conversion logic run under CI with no server; the identical suite
+  runs against a real server when ``UPOW_PG_DSN`` is set.
+
+The SQL subset the pg backend restricts itself to (so both drivers
+behave identically): explicit column lists, ``$n`` parameters, whole
+arrays as values (never indexed/ANY'd in SQL — the one exception,
+``= ANY(col)``, is translated by the mock), no NOW() (timestamps are
+passed in), row-value IN lists built with explicit placeholders.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import re
+import threading
+from decimal import Decimal
+from typing import Any, Iterable, List, Optional, Sequence
+
+
+def _utc(dt_or_epoch) -> datetime.datetime:
+    """Naive-UTC datetime (what the reference stores in TIMESTAMP(0)
+    columns via datetime.utcfromtimestamp)."""
+    if isinstance(dt_or_epoch, datetime.datetime):
+        return dt_or_epoch
+    return datetime.datetime.fromtimestamp(
+        int(dt_or_epoch), datetime.timezone.utc).replace(tzinfo=None)
+
+
+def _epoch(dt) -> int:
+    if isinstance(dt, (int, float)):
+        return int(dt)
+    return int(dt.replace(tzinfo=datetime.timezone.utc).timestamp())
+
+
+class AsyncpgDriver:
+    """One asyncpg connection on a private loop thread, sync facade.
+
+    Single-connection by design: the node's storage access is already
+    serialized through its event loop (the sqlite backend is one
+    connection too), and block acceptance wraps BEGIN/COMMIT around the
+    connection — a pool would break that transaction affinity.
+
+    Each call blocks the calling thread for one driver round trip —
+    the same short-synchronous-call model the sqlite backend uses, but
+    with a network RTT attached.  The storage layer batches its hot
+    paths into executemany/JOIN shapes to keep statements-per-block
+    low; deployments should colocate the node with the database (the
+    reference's asyncpg setup assumes the same).
+    """
+
+    def __init__(self, dsn: str):
+        import asyncio
+
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, daemon=True, name="pg-driver")
+        self._thread.start()
+        self._conn = self._call(self._connect(dsn))
+
+    async def _connect(self, dsn: str):
+        import asyncpg  # lazy: only a pg-backed node pays this import
+
+        return await asyncpg.connect(dsn)
+
+    def _call(self, coro):
+        import asyncio
+
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result()
+
+    def fetch(self, sql: str, args: Sequence[Any] = ()) -> List[Any]:
+        return self._call(self._conn.fetch(sql, *args))
+
+    def execute(self, sql: str, args: Sequence[Any] = ()) -> None:
+        self._call(self._conn.execute(sql, *args))
+
+    def executemany(self, sql: str, rows: Iterable[Sequence[Any]]) -> None:
+        rows = list(rows)
+        if rows:
+            self._call(self._conn.executemany(sql, rows))
+
+    def begin(self) -> None:
+        self.execute("BEGIN")
+
+    def commit(self) -> None:
+        self.execute("COMMIT")
+
+    def rollback(self) -> None:
+        self.execute("ROLLBACK")
+
+    def close(self) -> None:
+        try:
+            self._call(self._conn.close())
+        finally:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=5)
+
+
+# --- mock driver ---------------------------------------------------------
+
+# Output-column representation map (reference schema.sql types).  The pg
+# backend's SQL keeps these column names stable (including aliases), so
+# name-based conversion is unambiguous.
+_ARRAY_COLS = {"inputs_addresses", "outputs_addresses", "outputs_amounts"}
+_NUMERIC_COLS = {"fees", "reward", "difficulty"}
+_TIMESTAMP_COLS = {"timestamp", "propagation_time", "block_ts", "ts"}
+_BOOL_COLS = {"is_stake"}
+
+# sqlite DDL mirroring schema.sql's tables (same names, sqlite types);
+# "index" is kept verbatim — sqlite accepts it quoted.
+_MOCK_DDL = """
+CREATE TABLE IF NOT EXISTS blocks (
+    id INTEGER PRIMARY KEY,
+    hash TEXT UNIQUE,
+    content TEXT NOT NULL,
+    address TEXT NOT NULL,
+    random INTEGER NOT NULL,
+    difficulty TEXT NOT NULL,
+    reward TEXT NOT NULL,
+    timestamp INTEGER
+);
+CREATE TABLE IF NOT EXISTS transactions (
+    block_hash TEXT NOT NULL,
+    tx_hash TEXT UNIQUE,
+    tx_hex TEXT,
+    inputs_addresses TEXT,
+    outputs_addresses TEXT,
+    outputs_amounts TEXT,
+    fees TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS unspent_outputs (
+    tx_hash TEXT,
+    "index" INTEGER NOT NULL,
+    address TEXT NULL,
+    is_stake INTEGER
+);
+CREATE TABLE IF NOT EXISTS pending_transactions (
+    tx_hash TEXT UNIQUE,
+    tx_hex TEXT,
+    inputs_addresses TEXT,
+    fees TEXT NOT NULL,
+    propagation_time INTEGER NOT NULL
+);
+CREATE TABLE IF NOT EXISTS pending_spent_outputs (
+    tx_hash TEXT,
+    "index" INTEGER NOT NULL
+);
+"""
+for _t in ("inode_registration_output", "validator_registration_output",
+           "validators_voting_power", "delegates_voting_power",
+           "validators_ballot", "inodes_ballot"):
+    _MOCK_DDL += f"""
+CREATE TABLE IF NOT EXISTS {_t} (
+    tx_hash TEXT,
+    "index" INTEGER NOT NULL,
+    address TEXT NULL
+);
+"""
+
+_PLACEHOLDER = re.compile(r"\$(\d+)")
+_ANY_CLAUSE = re.compile(r"\$(\d+)\s*=\s*ANY\s*\(\s*(\w+)\s*\)")
+
+
+class MockPgDriver:
+    """sqlite stand-in executing the pg backend's SQL (tests only)."""
+
+    def __init__(self):
+        import sqlite3
+
+        self.db = sqlite3.connect(":memory:")
+        self.db.isolation_level = None  # autocommit; BEGIN/COMMIT explicit
+        self.db.row_factory = sqlite3.Row
+        self.db.executescript(_MOCK_DDL)
+
+    # -- translation --
+
+    @staticmethod
+    def _convert_in(value):
+        if isinstance(value, bool):
+            return int(value)
+        if isinstance(value, list):
+            return json.dumps(value)
+        if isinstance(value, datetime.datetime):
+            return _epoch(value)
+        if isinstance(value, Decimal):
+            return str(value)
+        return value
+
+    @staticmethod
+    def _convert_out(row) -> dict:
+        out = {}
+        for key in row.keys():
+            v = row[key]
+            if v is None:
+                out[key] = None
+            elif key in _ARRAY_COLS:
+                out[key] = json.loads(v)
+            elif key in _NUMERIC_COLS:
+                out[key] = Decimal(str(v))
+            elif key in _TIMESTAMP_COLS:
+                out[key] = _utc(v)
+            elif key in _BOOL_COLS:
+                out[key] = bool(v)
+            else:
+                out[key] = v
+        return out
+
+    @classmethod
+    def _translate(cls, sql: str):
+        """pg-dialect SQL -> (sqlite SQL using :pN named params)."""
+        # `$k = ANY(col)`: pg array membership -> sqlite json_each scan
+        sql = _ANY_CLAUSE.sub(
+            r"EXISTS (SELECT 1 FROM json_each(\2) WHERE"
+            r" json_each.value = :p\1)", sql)
+        return _PLACEHOLDER.sub(r":p\1", sql)
+
+    def _params(self, args: Sequence[Any]) -> dict:
+        return {f"p{i + 1}": self._convert_in(v) for i, v in enumerate(args)}
+
+    # -- facade --
+
+    def fetch(self, sql: str, args: Sequence[Any] = ()) -> List[dict]:
+        rows = self.db.execute(self._translate(sql), self._params(args)).fetchall()
+        return [self._convert_out(r) for r in rows]
+
+    def execute(self, sql: str, args: Sequence[Any] = ()) -> None:
+        self.db.execute(self._translate(sql), self._params(args))
+
+    def executemany(self, sql: str, rows: Iterable[Sequence[Any]]) -> None:
+        sql = self._translate(sql)
+        for args in rows:
+            self.db.execute(sql, self._params(args))
+
+    def begin(self) -> None:
+        self.db.execute("BEGIN")
+
+    def commit(self) -> None:
+        self.db.execute("COMMIT")
+
+    def rollback(self) -> None:
+        self.db.execute("ROLLBACK")
+
+    def close(self) -> None:
+        self.db.close()
