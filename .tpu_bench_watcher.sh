@@ -18,8 +18,8 @@ for i in $(seq 1 140); do
       echo "$(date -u +%H:%M:%S) bench done rc=$?" >> /tmp/tpuq/log
       timeout 1200 python bench_suite.py --configs 3 --seconds 10 > /tmp/tpuq/c3.out 2>/tmp/tpuq/c3.err
       echo "$(date -u +%H:%M:%S) c3 done rc=$?" >> /tmp/tpuq/log
-      timeout 1200 python bench_suite.py --configs 2,5 --seconds 10 > /tmp/tpuq/c25.out 2>/tmp/tpuq/c25.err
-      echo "$(date -u +%H:%M:%S) c25 done rc=$?" >> /tmp/tpuq/log
+      timeout 1200 python bench_suite.py --configs 2,5,7 --seconds 10 > /tmp/tpuq/c25.out 2>/tmp/tpuq/c25.err
+      echo "$(date -u +%H:%M:%S) c257 done rc=$?" >> /tmp/tpuq/log
       ran_queue=1
       sleep 7200
       continue

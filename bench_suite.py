@@ -423,6 +423,36 @@ def config6_block8k(seconds: float):
     _emit(f"block_accept_8k_warm_{_platform()}", rate_warm, "tx/s", base_rate)
 
 
+def config7_txid_batch(seconds: float):
+    """Host hashlib vs device sha256_batch_jnp for an 8k-tx page of
+    ~400 B payloads — the measured crossover behind device.txid_backend
+    (crypto/sha256.txid_batch; reference manager.py:365-378)."""
+    import random
+
+    from upow_tpu.crypto.sha256 import sha256_batch_jnp
+
+    rng = random.Random(0xD1E5)
+    payloads = [rng.randbytes(rng.randint(150, 600)) for _ in range(8192)]
+
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < seconds:
+        for p in payloads:
+            hashlib.sha256(p).digest()
+        n += len(payloads)
+    host_rate = n / (time.perf_counter() - t0)
+    _emit(f"txid_batch_host_{_platform()}", host_rate, "hash/s", None)
+
+    sha256_batch_jnp(payloads)  # compile warmup
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < seconds:
+        sha256_batch_jnp(payloads)
+        n += len(payloads)
+    dev_rate = n / (time.perf_counter() - t0)
+    _emit(f"txid_batch_device_{_platform()}", dev_rate, "hash/s", host_rate)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="1,2,3,4,5,6")
@@ -442,8 +472,9 @@ def main() -> int:
         "4": lambda: config4_replay(args.seconds),
         "5": lambda: config5_sharded(args.seconds),
         "6": lambda: config6_block8k(args.seconds),
+        "7": lambda: config7_txid_batch(args.seconds),
     }
-    needs_device = {"2", "3", "5"}
+    needs_device = {"2", "3", "5", "7"}
     for key in args.configs.split(","):
         key = key.strip()
         if key in needs_device and _platform() == "hung":

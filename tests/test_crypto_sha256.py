@@ -125,3 +125,43 @@ def test_sha256_batch_jnp_mixed_lengths():
     got = sha256_batch_jnp(msgs)
     for m, d in zip(msgs, got):
         assert d == hashlib.sha256(m).digest()
+
+
+def test_txid_batch_device_matches_hashlib():
+    from upow_tpu.crypto.sha256 import txid_batch
+
+    payloads = [_rand_bytes(n) for n in [120, 250, 250, 300, 400, 400, 1000]]
+    host = txid_batch(payloads, backend="host")
+    dev = txid_batch(payloads, backend="device", min_batch=1)
+    assert host == dev
+    assert host == [hashlib.sha256(p).hexdigest() for p in payloads]
+
+
+def test_txid_batch_small_batches_stay_host(monkeypatch):
+    """Below min_batch the device path must never be dispatched."""
+    import upow_tpu.crypto.sha256 as sha_mod
+
+    def boom(_msgs):
+        raise AssertionError("device path dispatched for a small batch")
+
+    monkeypatch.setattr(sha_mod, "sha256_batch_jnp", boom)
+    payloads = [_rand_bytes(64) for _ in range(8)]
+    got = sha_mod.txid_batch(payloads, backend="device", min_batch=64)
+    assert got == [hashlib.sha256(p).hexdigest() for p in payloads]
+
+
+def test_txid_batch_integrity_sample_falls_back(monkeypatch):
+    """A device batch returning a wrong digest must be discarded wholesale
+    (txids are consensus — one silent corruption would fork the node)."""
+    import upow_tpu.crypto.sha256 as sha_mod
+
+    payloads = [_rand_bytes(100) for _ in range(6)]
+
+    def corrupt(msgs):
+        out = [hashlib.sha256(m).digest() for m in msgs]
+        out[0] = b"\x00" * 32
+        return out
+
+    monkeypatch.setattr(sha_mod, "sha256_batch_jnp", corrupt)
+    got = sha_mod.txid_batch(payloads, backend="device", min_batch=1)
+    assert got == [hashlib.sha256(p).hexdigest() for p in payloads]

@@ -26,6 +26,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))) i
 sys.path.insert(0, {repo!r})
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
+from upow_tpu import compile_cache
+compile_cache.enable(os.path.join({repo!r}, ".jax_cache"))
 from upow_tpu.parallel import multihost
 
 active = multihost.initialize(coordinator_address={coord!r},
@@ -76,9 +78,48 @@ if global_hit != int(SENTINEL):
     from upow_tpu.core.difficulty import check_pow_hash
     ok = check_pow_hash(digest, header.previous_hash, "1.0")
 
+# --- cross-PROCESS DP signature verify (VERDICT r2 ask #6): one batch,
+# each process verifies its disjoint half on its own device, verdicts
+# combine through collectives over the global mesh and must match the
+# host oracle (reference hot spot: manager.py:628-632). ---
+from upow_tpu.crypto import p256
+
+n_sigs = 32
+digs = []
+sigs = []
+pubs = []
+expected = []
+for i in range(n_sigs):
+    msg = b"live-mh-%d" % i
+    d, pub_i = curve.keygen(rng=0x5000 + i)
+    sig = curve.sign(msg, d)
+    if i % 5 == 0:
+        sig = (sig[0], sig[1] ^ 1)  # corrupt a known subset
+    digs.append(hashlib.sha256(msg).digest())
+    sigs.append(sig)
+    pubs.append(pub_i)
+    expected.append(bool(curve.verify(sig, msg, pub_i)))
+
+half = n_sigs // 2
+s = jax.process_index() * half
+local_v = np.asarray(
+    p256.verify_batch_prehashed(digs[s:s + half], sigs[s:s + half],
+                                pubs[s:s + half]),
+    dtype=np.uint32)
+verdicts = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("hosts")), local_v)
+weights = jnp.arange(1, n_sigs + 1, dtype=jnp.uint32)
+v_total = int(jax.jit(jnp.sum)(verdicts))
+v_check = int(jax.jit(lambda a: jnp.sum(a * weights))(verdicts))
+verify_ok = (
+    v_total == sum(expected)
+    and v_check == sum((i + 1) * int(v) for i, v in enumerate(expected))
+    and 0 < sum(expected) < n_sigs  # both verdict classes present
+)
+
 print("RESULT " + json.dumps({{
     "pid": {pid}, "range": [lo, hi], "local": local_hit,
-    "global": global_hit, "pow_ok": ok,
+    "global": global_hit, "pow_ok": ok, "verify_ok": verify_ok,
 }}), flush=True)
 """
 
@@ -138,6 +179,8 @@ def test_two_process_distributed_search():
     # both processes agree on the global winner, and it is the min
     assert r0["global"] == r1["global"] == min(r0["local"], r1["local"])
     assert r0["pow_ok"] and r1["pow_ok"]
+    # cross-process DP verify agreed with the host oracle on both hosts
+    assert r0["verify_ok"] and r1["verify_ok"]
     # difficulty 1.0 over 2^18 nonces: a hit is ~certain; if this ever
     # flakes the search itself regressed
     from upow_tpu.crypto import SENTINEL

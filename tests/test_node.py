@@ -464,6 +464,52 @@ def test_sync_with_transactions(tmp_path, keys):
     run_cluster(tmp_path, scenario)
 
 
+def test_sync_with_device_txid_batch(tmp_path, keys, monkeypatch):
+    """Identical-verdict: a page ingested with the device txid batch
+    (sha256_batch_jnp seeding every tx's hash memo) accepts the same
+    chain and fingerprint as host hashing (VERDICT r2 ask #5)."""
+
+    async def scenario(cluster):
+        node_a, client_a = await cluster.add_node("a")
+        node_b, client_b = await cluster.add_node("b")
+        node_b.config.device.txid_backend = "device"
+        node_b.config.device.txid_min_batch = 2
+        import upow_tpu.crypto.sha256 as sha_mod
+
+        calls = []
+        real = sha_mod.txid_batch
+
+        def spy(payloads, **kw):
+            out = real(payloads, **kw)
+            calls.append((len(payloads), kw.get("backend")))
+            return out
+
+        monkeypatch.setattr(sha_mod, "txid_batch", spy)
+        await mine_via_api(client_a, keys["addr"])
+        builder = WalletBuilder(node_a.state)
+        tx = await builder.create_transaction(keys["d"], keys["addr2"], "2")
+        await node_a.state.add_pending_transaction(tx)
+        await mine_via_api(client_a, keys["addr"])
+        res = await (await client_b.get(
+            "/sync_blockchain", params={"node_url": cluster.url(0)})).json()
+        assert res["ok"], res
+        assert calls and calls[0][1] == "device"  # batch path really ran
+        assert (await node_b.state.get_address_balance(keys["addr2"])) \
+            == 2 * 10**8
+        assert (await node_a.state.get_unspent_outputs_hash()
+                == await node_b.state.get_unspent_outputs_hash())
+        # the seeded memos match independent hashing
+        for h in await node_b.state.get_block_transaction_hashes(
+                (await node_b.state.get_last_block())["hash"]):
+            tx_b = await node_b.state.get_transaction(h)
+            import hashlib
+
+            assert tx_b.hash() == hashlib.sha256(
+                bytes.fromhex(tx_b.hex())).hexdigest()
+
+    run_cluster(tmp_path, scenario)
+
+
 def test_fork_reorg_convergence(tmp_path, keys):
     """Partition: A and B mine divergent chains; B (shorter) syncs from A
     and reorgs onto A's chain (main.py:167-185's common-ancestor walk)."""

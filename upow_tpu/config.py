@@ -41,6 +41,12 @@ class DeviceConfig:
                                     # node sqlite is already fast)
     verify_kernel: str = ""         # "" = default | jac | complete
     verify_window: int = 0          # 0 = default | 4 | 5  (jac ladder w)
+    txid_backend: str = "auto"      # auto | device | host — batch txid
+                                    # hashing for sync pages / block
+                                    # accept (crypto/sha256.txid_batch);
+                                    # auto resolves by measuring both
+                                    # once per process
+    txid_min_batch: int = 256       # below this, always hashlib
 
     def resolve_search_backend(self, platform: str) -> str:
         if self.search_backend != "auto":

@@ -399,6 +399,108 @@ def _sha256_blocks_jnp(words, n_blocks: int):
     return jnp.stack(state, axis=1)
 
 
+_TXID_AUTO_CHOICE = None  # resolved once per process, by measurement
+
+
+def txid_batch(payloads: Sequence[bytes], backend: str = "auto",
+               min_batch: int = 256) -> list:
+    """Batched txids (hex digests) for a sync page / block accept
+    (reference manager.py:365-378 hashes every tx serially).
+
+    ``backend``:
+      host    — hashlib per payload (the baseline),
+      device  — one :func:`sha256_batch_jnp` dispatch per length bucket,
+      auto    — measured crossover, resolved ONCE per process: time both
+                on the first big-enough batch and keep the winner.  On a
+                tunneled chip (~100 ms RTT) or any CPU host the host path
+                wins by orders of magnitude; on a local chip the device
+                only pays for very large pages — measuring beats guessing
+                either way.
+
+    Device digests feed consensus (txids), so a host-side integrity
+    sample (first/middle/last payload) guards every device batch; any
+    mismatch falls back to hashlib for the whole batch.
+    """
+    import hashlib as _hl
+
+    def host(ps):
+        return [_hl.sha256(p).hexdigest() for p in ps]
+
+    if backend == "host" or len(payloads) < min_batch:
+        return host(payloads)
+    if backend == "auto":
+        global _TXID_AUTO_CHOICE
+        if _TXID_AUTO_CHOICE is None:
+            _TXID_AUTO_CHOICE, measured = _measure_txid_crossover(
+                payloads, host)
+            if measured is not None:
+                return measured  # the measurement already hashed this batch
+        backend = _TXID_AUTO_CHOICE
+        if backend == "host":
+            return host(payloads)
+    try:
+        digests = sha256_batch_jnp(payloads)
+    except Exception as e:  # device sick mid-run: the node must not stall
+        import logging
+
+        logging.getLogger("upow_tpu.crypto").warning(
+            "device txid batch failed (%s); host fallback", e)
+        return host(payloads)
+    out = [d.hex() for d in digests]
+    for i in {0, len(out) // 2, len(out) - 1}:
+        if _hl.sha256(payloads[i]).hexdigest() != out[i]:
+            import logging
+
+            logging.getLogger("upow_tpu.crypto").warning(
+                "device txid digest mismatch at sample %d; "
+                "host fallback for this batch", i)
+            return host(payloads)
+    return out
+
+
+def _measure_txid_crossover(payloads, host_fn):
+    """Time hashlib vs the device batch on real payloads; pick the
+    winner for the rest of the process.  A hung/failed device resolves
+    to host (the same thread-boxed probe discipline as verify).
+
+    Returns ``(choice, digests_or_None)`` — the measurement already
+    hashed the batch, so the host digests are handed back to avoid a
+    second full pass on the first sync page (device digests are NOT
+    reused: they haven't been integrity-sampled).
+    """
+    import logging
+    import time as _t
+
+    from ..benchutil import boxed_call, probed_platform_cached
+
+    log = logging.getLogger("upow_tpu.crypto")
+    if probed_platform_cached(timeout=90.0) in (None, "cpu"):
+        log.info("txid auto: no accelerator; host hashing")
+        return "host", None
+    t0 = _t.perf_counter()
+    host_digests = host_fn(payloads)
+    t_host = _t.perf_counter() - t0
+
+    def device_once():
+        return sha256_batch_jnp(payloads)
+
+    status, _ = boxed_call(device_once, timeout=240.0)  # compile warmup
+    if status != "ok":
+        log.warning("txid auto: device probe %s; host hashing", status)
+        return "host", host_digests
+    t0 = _t.perf_counter()
+    status, _ = boxed_call(device_once, timeout=60.0)
+    t_dev = _t.perf_counter() - t0
+    if status != "ok":
+        log.warning("txid auto: device re-run %s; host hashing", status)
+        return "host", host_digests
+    choice = "device" if t_dev < t_host else "host"
+    log.info("txid auto: host %.1fms vs device %.1fms for %d payloads -> %s",
+             t_host * 1e3, t_dev * 1e3, len(payloads), choice)
+    # either way the verified-correct host digests serve this batch
+    return choice, host_digests
+
+
 def sha256_batch_jnp(messages: Sequence[bytes]) -> list:
     """Batched sha256 of equal-or-bucketed-length messages on device.
 

@@ -1052,13 +1052,52 @@ class Node:
         last_id = last_block["id"] if last_block else 0
         last_hash = last_block["hash"] if last_block else GENESIS_PREV_HASH
         i = last_id + 1
+        # batched txids for the whole page (SURVEY §2.2): one device (or
+        # hashlib) batch seeds every tx's hash memo instead of a
+        # per-instance sha256 on first .hash() — guarded below by a
+        # round-trip identity check before any seed is trusted
+        txid_prefill: dict = {}
+        dev_cfg = self.config.device
+        if dev_cfg.txid_backend != "host":
+            try:
+                all_hex = [t for b in blocks
+                           for t in b.get("transactions", ())]
+                if len(all_hex) >= dev_cfg.txid_min_batch:
+                    import functools
+
+                    from ..crypto.sha256 import txid_batch
+
+                    # executor: the first auto-measurement may block for
+                    # minutes against a hung device; the per-block parse
+                    # loop below must stay the error boundary, so any
+                    # failure here (bad hex from the peer included) just
+                    # skips the prefill
+                    digests = await asyncio.get_event_loop() \
+                        .run_in_executor(None, functools.partial(
+                            txid_batch,
+                            [bytes.fromhex(h) for h in all_hex],
+                            backend=dev_cfg.txid_backend,
+                            min_batch=dev_cfg.txid_min_batch))
+                    txid_prefill = dict(zip(all_hex, digests))
+            except Exception as e:
+                log.info("txid prefill skipped: %s", e)
         parsed, overlay = [], {}
         parse_error = None
         for block_info in blocks:
             try:
                 block = dict(block_info["block"])
-                txs = [await self._parse_tx(t, overlay=overlay)
-                       for t in block_info["transactions"]]
+                txs = []
+                for t in block_info["transactions"]:
+                    tx = await self._parse_tx(t, overlay=overlay)
+                    seed = txid_prefill.get(t)
+                    # seed only when re-serialization is byte-identical
+                    # to the wire form (txid = sha256 of the
+                    # re-serialized hex — consensus; hex() is memoized
+                    # and needed later by storage, so this costs nothing)
+                    if seed is not None and getattr(tx, "_hash", "x") is None \
+                            and tx.hex() == t:
+                        tx._hash = seed
+                    txs.append(tx)
             except Exception as e:
                 # keep the valid prefix: the accept loop below still
                 # commits every block parsed so far (the interleaved
