@@ -35,6 +35,10 @@ _LAST_GOOD_TPU = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".last_good_tpu.json")
 
 
+_TPU_HISTORY = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".tpu_bench_history.jsonl")
+
+
 def _record_last_good_tpu(result: dict) -> None:
     import datetime
 
@@ -42,10 +46,36 @@ def _record_last_good_tpu(result: dict) -> None:
     entry["measured_at"] = datetime.datetime.now(
         datetime.timezone.utc).isoformat(timespec="seconds")
     try:
+        # cross-session drift on the tunneled chip is ~1.5x; keep every
+        # sample so headline numbers can carry spread, not just a point
+        with open(_TPU_HISTORY, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+        entry["history"] = _history_stats(entry["metric"])
         with open(_LAST_GOOD_TPU, "w") as f:
             json.dump(entry, f)
     except OSError:
         pass
+
+
+def _history_stats(metric: str):
+    """(n, min, median, max) over recorded TPU samples of one metric."""
+    try:
+        values = []
+        with open(_TPU_HISTORY) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue  # torn append (killed mid-write); keep rest
+                if r.get("metric") == metric and r.get("value"):
+                    values.append(r["value"])
+        if not values:
+            return None
+        values.sort()
+        return {"n": len(values), "min": values[0],
+                "median": values[len(values) // 2], "max": values[-1]}
+    except (OSError, ValueError):
+        return None
 
 
 def _load_last_good_tpu():
