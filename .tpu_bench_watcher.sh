@@ -16,6 +16,8 @@ for i in $(seq 1 140); do
       echo "$(date -u +%H:%M:%S) tunnel healthy, running queue" >> /tmp/tpuq/log
       timeout 900 python bench.py > /tmp/tpuq/bench.out 2>/tmp/tpuq/bench.err
       echo "$(date -u +%H:%M:%S) bench done rc=$?" >> /tmp/tpuq/log
+      timeout 3000 python -u .tpu_tile_ab.py > /tmp/tpuq/ab.out 2>/tmp/tpuq/ab.err
+      echo "$(date -u +%H:%M:%S) ab done rc=$?" >> /tmp/tpuq/log
       timeout 1200 python bench_suite.py --configs 3 --seconds 10 > /tmp/tpuq/c3.out 2>/tmp/tpuq/c3.err
       echo "$(date -u +%H:%M:%S) c3 done rc=$?" >> /tmp/tpuq/log
       timeout 1200 python bench_suite.py --configs 2,5,7 --seconds 10 > /tmp/tpuq/c25.out 2>/tmp/tpuq/c25.err

@@ -147,8 +147,20 @@ class PgChainState(StateViews):
     def ensure_schema(self) -> None:
         """Create any missing tables (idempotent; a pre-existing uPow
         database passes through untouched)."""
+        if getattr(self.drv, "schema_preinstalled", False):
+            return  # the mock creates its sqlite-dialect schema itself
         for stmt in PG_SCHEMA:
             self.drv.execute(stmt)
+        # the reference schema also declares a composite type
+        # (schema.sql:22-25).  CREATE TYPE has no IF NOT EXISTS, so guard
+        # server-side (locale-independent, unlike matching the error
+        # text); the sqlite mock has no composite types — skip there.
+        if getattr(self.drv, "supports_composite_types", True):
+            self.drv.execute(
+                "DO $$ BEGIN"
+                " CREATE TYPE tx_output AS (tx_hash CHAR(64), index SMALLINT);"
+                " EXCEPTION WHEN duplicate_object THEN NULL;"
+                " END $$")
 
     def close(self):
         self.drv.close()

@@ -179,6 +179,11 @@ _ANY_CLAUSE = re.compile(r"\$(\d+)\s*=\s*ANY\s*\(\s*(\w+)\s*\)")
 class MockPgDriver:
     """sqlite stand-in executing the pg backend's SQL (tests only)."""
 
+    supports_composite_types = False  # no CREATE TYPE in sqlite
+    schema_preinstalled = True  # __init__ applies the sqlite-dialect DDL
+    # (the reference-dialect PG_SCHEMA text is pg-only: it spells the
+    # outpoint column as unquoted `index`, reserved in sqlite)
+
     def __init__(self):
         import sqlite3
 
