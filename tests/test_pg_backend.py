@@ -361,3 +361,47 @@ def test_pg_reindex_check_detects_corruption():
         state.close()
 
     run(main())
+
+
+def test_pg_backend_sync_page_ingest(tmp_path):
+    """The node's page-ingest sync path (create_blocks →
+    create_block_syncing) runs against the pg backend and reproduces
+    the sqlite source chain's fingerprint — the drop-in scenario of a
+    pg-backed node catching up from a reference-shaped peer."""
+    from upow_tpu.config import Config
+    from upow_tpu.node.app import Node
+
+    async def main():
+        src = ChainState()
+        manager = BlockManager(src, sig_backend="host")
+        builder = WalletBuilder(src)
+        actors = make_actors()
+        d_g, a_g = actors["genesis"]
+        _, a_o = actors["outsider"]
+        for _ in range(3):
+            await mine_block(manager, src, a_g)
+        tx = await builder.create_transaction(d_g, a_o, "2")
+        await push(src, tx)
+        await mine_block(manager, src, a_g, include_pending=True)
+
+        cfg = Config()
+        cfg.node.db_path = ""
+        cfg.node.seed_url = ""
+        cfg.node.peers_file = str(tmp_path / "pg_replica_nodes.json")
+        cfg.node.ip_config_file = ""
+        cfg.device.sig_backend = "host"
+        cfg.log.path = ""
+        cfg.log.console = False
+        node = Node(cfg, state=PgChainState(driver=MockPgDriver()))
+
+        page = await src.get_blocks(1, 100)
+        errors = []
+        assert await node.create_blocks(page, errors), errors
+        assert (await node.state.get_last_block())["id"] == 4
+        assert (await node.state.get_unspent_outputs_hash()
+                == await src.get_unspent_outputs_hash())
+        assert await node.state.get_address_balance(a_o) == 2 * SMALLEST
+        src.close()
+        await node.close()
+
+    run(main())

@@ -60,11 +60,15 @@ class Node:
     together via aiohttp's test utilities).
     """
 
-    def __init__(self, config: Optional[Config] = None):
+    def __init__(self, config: Optional[Config] = None, state=None):
         self.config = config or Config()
         setup_logging(self.config.log)
         self.config.device.apply_kernel_overrides()
-        if self.config.node.db_backend == "postgres":
+        if state is not None:
+            # injected backend (tests: the pg backend over the mock
+            # driver; a live server would come through config instead)
+            self.state = state
+        elif self.config.node.db_backend == "postgres":
             # reference-ecosystem interop: run against an existing uPow
             # PostgreSQL database (schema.sql) via asyncpg
             from ..state.pg import PgChainState
