@@ -405,3 +405,45 @@ def test_pg_backend_sync_page_ingest(tmp_path):
         await node.close()
 
     run(main())
+
+
+def test_pg_device_index_matches_sql():
+    """Device UTXO index on the pg backend: same chain driven with the
+    index on vs off makes identical membership decisions and survives a
+    reorg resync (the sqlite twin lives in test_chain)."""
+
+    async def scenario(device_index: bool):
+        state = PgChainState(driver=MockPgDriver())
+        if device_index:
+            state.enable_device_index()
+            assert state._dev_index is not None
+        manager = BlockManager(state, sig_backend="host")
+        builder = WalletBuilder(state)
+        actors = make_actors()
+        d_g, a_g = actors["genesis"]
+        _, a_o = actors["outsider"]
+        for _ in range(3):
+            await mine_block(manager, state, a_g)
+        tx = await builder.create_transaction(d_g, a_o, "2")
+        await push(state, tx)
+        await mine_block(manager, state, a_g, include_pending=True)
+
+        spent = tx.inputs[0].outpoint
+        created = (tx.hash(), 0)
+        verdicts = await state.outpoints_exist(
+            [spent, created, ("ff" * 32, 0)])
+
+        await state.remove_blocks(4)  # reorg: index must resync
+        verdicts_after = await state.outpoints_exist(
+            [spent, created, ("ff" * 32, 0)])
+        fingerprint = await state.get_unspent_outputs_hash()
+        state.close()
+        return verdicts, verdicts_after, fingerprint
+
+    clock.reset()
+    off = run(scenario(False))
+    clock.reset()
+    on = run(scenario(True))
+    assert on == off
+    assert on[0] == [False, True, False]   # spent gone, new output present
+    assert on[1] == [True, False, False]   # reorg restored the spend
