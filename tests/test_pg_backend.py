@@ -267,9 +267,16 @@ def test_mempool_ordering_and_propagation(make_state):
     run(main())
 
 
-def test_cross_backend_fingerprint_equivalence():
+def test_cross_backend_fingerprint_equivalence(monkeypatch):
     """The same chain produces identical UTXO fingerprints and balances
     on the sqlite and postgres backends."""
+    import time as _time
+
+    # freeze the wall clock: block hashes are timestamp-dependent and
+    # the two builds must not straddle a real-second boundary
+    base = int(_time.time())
+    monkeypatch.setattr(
+        clock, "time", type("T", (), {"time": staticmethod(lambda: base)}))
 
     async def build(state):
         manager = BlockManager(state, sig_backend="host")
@@ -447,3 +454,50 @@ def test_pg_device_index_matches_sql():
     assert on == off
     assert on[0] == [False, True, False]   # spent gone, new output present
     assert on[1] == [True, False, False]   # reorg restored the spend
+
+
+def test_pg_concurrent_writer_isolated_from_atomic_rollback():
+    """Every pg driver call is now a yield point, so a concurrent
+    writer could otherwise land its statements inside another task's
+    open accept transaction and be rolled back with it.  The writer
+    lock must serialize them: the pending insert survives a concurrent
+    atomic() rollback, and the rolled-back block vanishes."""
+
+    async def main():
+        state = PgChainState(driver=MockPgDriver())
+        manager = BlockManager(state, sig_backend="host")
+        builder = WalletBuilder(state)
+        actors = make_actors()
+        d_g, a_g = actors["genesis"]
+        _, a_o = actors["outsider"]
+        for _ in range(3):
+            await mine_block(manager, state, a_g)
+        tx = await builder.create_transaction(d_g, a_o, "1")
+
+        entered = asyncio.Event()
+        release = asyncio.Event()
+
+        async def failing_accept():
+            try:
+                async with state.atomic():
+                    await state.add_block(
+                        99, "aa" * 32, "", a_g, 0, Decimal("1.0"), 0,
+                        clock.timestamp())
+                    entered.set()
+                    await release.wait()  # hold the txn open across awaits
+                    raise RuntimeError("validation failed")
+            except RuntimeError:
+                pass
+
+        async def concurrent_intake():
+            await entered.wait()
+            release.set()
+            await state.add_pending_transaction(tx)
+
+        await asyncio.gather(failing_accept(), concurrent_intake())
+        # the rollback took ONLY the accept's writes
+        assert await state.get_block_by_id(99) is None
+        assert await state.pending_transaction_exists(tx.hash())
+        state.close()
+
+    run(main())

@@ -24,7 +24,11 @@ the rest of the framework sees one API (int smallest-units, epoch ints):
 
 The driver seam (state/pgdriver.py) keeps the SQL here runnable both on
 asyncpg (production) and on the sqlite-backed mock (CI without a
-server); see that module for the SQL-subset discipline.
+server); see that module for the SQL-subset discipline.  The async
+storage methods await the driver's awaitable facade, so database round
+trips never block the node's event loop (the reference's asyncpg usage
+is async-native the same way); only CLI tooling uses the blocking
+facade.
 
 Not supported on this backend (documented divergences): the sqlite
 memo caches (every read hits the DB — correctness-first; the node's
@@ -33,6 +37,7 @@ hot verify path batches at a higher level), and WAL-specific behaviors.
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 from contextlib import asynccontextmanager
 from decimal import Decimal
@@ -125,24 +130,95 @@ class PgChainState(StateViews):
         self.emission_path = emission_path
         self._dev_index: Optional[Dict[str, object]] = None
         self._in_atomic = False
+        # transaction-scope exclusivity: every DB call is a yield point
+        # now (awaitable driver), so without this a concurrent writer's
+        # statements would land INSIDE another task's open BEGIN and get
+        # committed/rolled back with it.  Lazy: asyncio.Lock binds to
+        # the running loop on first acquire.  _txn_owner distinguishes
+        # the task that opened the transaction (its nested writes join
+        # it) from foreign tasks (which must wait on the lock).
+        self._write_lock = None
+        self._txn_owner = None
+        self._index_mutations = 0  # dirty counter: rollback only pays
+        # the full index resync if the transaction actually touched it
+
+    def _writer(self):
+        if self._write_lock is None:
+            self._write_lock = asyncio.Lock()
+        return self._write_lock
+
+    def _owns_txn(self) -> bool:
+        return self._in_atomic and self._txn_owner is asyncio.current_task()
+
+    @asynccontextmanager
+    async def _open_txn(self, commit: bool = True):
+        """The single home of writer-lock + transaction bookkeeping:
+        acquire the lock, mark this task as owner (its nested writes
+        join the transaction), BEGIN, then COMMIT — or ROLLBACK on
+        error or when ``commit=False`` (replay).  Any rollback resyncs
+        the device index (in-memory mutations from the discarded
+        transaction would otherwise turn into definitive false
+        negatives in the membership prefilter), still under the lock."""
+        async with self._writer():
+            self._in_atomic = True
+            self._txn_owner = asyncio.current_task()
+            rolled_back = False
+            mutations_at_entry = self._index_mutations
+            try:
+                await self.drv.abegin()
+                yield
+                if commit:
+                    await self.drv.acommit()
+                else:
+                    rolled_back = True
+                    await self.drv.arollback()
+            except BaseException:
+                rolled_back = True
+                await self.drv.arollback()
+                raise
+            finally:
+                # also covers a failed BEGIN: leaking the owner flags
+                # would let this task's later writes bypass the lock
+                self._in_atomic = False
+                self._txn_owner = None
+                if rolled_back and \
+                        self._index_mutations != mutations_at_entry:
+                    # in-memory index mutations from the discarded
+                    # transaction would otherwise become definitive
+                    # false negatives in the membership prefilter
+                    await self._aindex_rebuild()
 
     @asynccontextmanager
     async def _txn(self):
         """Group a multi-statement mutation into one transaction unless
-        an outer atomic() already holds one.  The sqlite backend gets
-        this implicitly (sqlite3 defers commit until _commit()); with
-        per-statement autocommit a crash mid-reorg would otherwise leave
-        torn chain state."""
-        if self._in_atomic:
+        this task already holds one (nested _txn — e.g. rebuild_utxos →
+        add_transaction_outputs — joins it).  The sqlite backend gets
+        transactionality implicitly (sqlite3 defers commit until
+        _commit()); with per-statement autocommit a crash mid-reorg
+        would otherwise leave torn chain state."""
+        if self._owns_txn():
             yield
             return
-        self.drv.begin()
-        try:
+        async with self._open_txn():
             yield
-            self.drv.commit()
-        except BaseException:
-            self.drv.rollback()
-            raise
+
+    @asynccontextmanager
+    async def _write_guard(self):
+        """Exclusivity without a transaction wrapper, for writes that
+        are a single (auto-committed) statement — BEGIN/COMMIT would be
+        two extra round trips for no additional guarantee."""
+        if self._owns_txn():
+            yield
+            return
+        async with self._writer():
+            yield
+
+    @asynccontextmanager
+    async def replay_transaction(self):
+        """Open a transaction, run the body joined to it, and ALWAYS
+        roll back at exit — the reindex --check primitive."""
+        async with self._open_txn(commit=False):
+            yield
 
     def ensure_schema(self) -> None:
         """Create any missing tables (idempotent; a pre-existing uPow
@@ -168,32 +244,21 @@ class PgChainState(StateViews):
     @asynccontextmanager
     async def atomic(self):
         """One transaction around a whole block acceptance (the driver
-        autocommits individual statements outside of this)."""
-        self._in_atomic = True
-        try:
-            self.drv.begin()
+        autocommits individual statements outside of this).  Holds the
+        writer lock for the duration: reads may interleave between the
+        transaction's statements (same semantics as the sqlite backend's
+        shared connection), foreign writes may not."""
+        async with self._open_txn():
             yield
-            self.drv.commit()
-        except BaseException:
-            self.drv.rollback()
-            self._index_rebuild()
-            raise
-        finally:
-            self._in_atomic = False
 
     # ------------------------------------------------------ device index --
 
     def enable_device_index(self) -> None:
         """Same device-resident membership prefilter as the sqlite
-        backend (storage.py enable_device_index)."""
-        from ..benchutil import probed_platform_cached
-
-        if probed_platform_cached(timeout=90.0) is None:
-            import logging
-
-            logging.getLogger("upow_tpu.state").warning(
-                "jax backend init hung/failed; device UTXO index disabled")
-            self._dev_index = None
+        backend (storage.py enable_device_index).  Sync (blocking) —
+        called once at node boot; runtime resyncs go through
+        :meth:`_aindex_rebuild`."""
+        if not self._device_index_usable():
             return
         from .device_index import DeviceUtxoIndex
 
@@ -203,29 +268,56 @@ class PgChainState(StateViews):
             self._dev_index[table] = DeviceUtxoIndex(
                 (r["tx_hash"], r["index"]) for r in rows)
 
+    def _device_index_usable(self) -> bool:
+        from ..benchutil import probed_platform_cached
+
+        if probed_platform_cached(timeout=90.0) is None:
+            import logging
+
+            logging.getLogger("upow_tpu.state").warning(
+                "jax backend init hung/failed; device UTXO index disabled")
+            self._dev_index = None
+            return False
+        return True
+
     def _index_add(self, table, outpoints):
         if self._dev_index is not None:
+            self._index_mutations += 1
             self._dev_index[table].add(outpoints)
 
     def _index_remove(self, table, outpoints):
         if self._dev_index is not None:
+            self._index_mutations += 1
             self._dev_index[table].remove(outpoints)
 
-    def _index_rebuild(self):
-        if self._dev_index is not None:
-            self.enable_device_index()
+    async def _aindex_rebuild(self):
+        """Resync the device index from the live tables without blocking
+        the event loop (reorg rollback / replay paths)."""
+        if self._dev_index is None or not self._device_index_usable():
+            return
+        from .device_index import DeviceUtxoIndex
+
+        fresh = {}
+        for table in ("unspent_outputs",) + _GOV_TABLES:
+            rows = await self.drv.afetch(
+                f'SELECT tx_hash, "index" FROM {table}')
+            fresh[table] = DeviceUtxoIndex(
+                (r["tx_hash"], r["index"]) for r in rows)
+        self._dev_index = fresh
 
     # ------------------------------------------------------------- blocks --
 
     async def add_block(self, block_id: int, block_hash: str, content: str,
                         address: str, nonce: int, difficulty, reward: int,
                         ts: int) -> None:
-        self.drv.execute(
-            "INSERT INTO blocks (id, hash, content, address, random,"
-            " difficulty, reward, timestamp) VALUES ($1,$2,$3,$4,$5,$6,$7,$8)",
-            (block_id, block_hash, content, address, nonce,
-             Decimal(str(difficulty)), _coins(reward), _utc(ts)),
-        )
+        async with self._write_guard():
+            await self.drv.aexecute(
+                "INSERT INTO blocks (id, hash, content, address, random,"
+                " difficulty, reward, timestamp)"
+                " VALUES ($1,$2,$3,$4,$5,$6,$7,$8)",
+                (block_id, block_hash, content, address, nonce,
+                 Decimal(str(difficulty)), _coins(reward), _utc(ts)),
+            )
 
     @staticmethod
     def _block_dict(r) -> dict:
@@ -241,31 +333,31 @@ class PgChainState(StateViews):
         }
 
     async def get_block(self, block_hash: str) -> Optional[dict]:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT * FROM blocks WHERE hash = $1", (block_hash,))
         return self._block_dict(rows[0]) if rows else None
 
     async def get_block_by_id(self, block_id: int) -> Optional[dict]:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT * FROM blocks WHERE id = $1", (block_id,))
         return self._block_dict(rows[0]) if rows else None
 
     async def get_last_block(self) -> Optional[dict]:
-        rows = self.drv.fetch("SELECT * FROM blocks ORDER BY id DESC LIMIT 1")
+        rows = await self.drv.afetch("SELECT * FROM blocks ORDER BY id DESC LIMIT 1")
         return self._block_dict(rows[0]) if rows else None
 
     async def get_next_block_id(self) -> int:
-        rows = self.drv.fetch("SELECT MAX(id) AS m FROM blocks")
+        rows = await self.drv.afetch("SELECT MAX(id) AS m FROM blocks")
         return (rows[0]["m"] or 0) + 1
 
     async def get_blocks(self, offset: int, limit: int) -> List[dict]:
         """Blocks with embedded full transactions (database.py:380-437)."""
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT * FROM blocks WHERE id >= $1 ORDER BY id LIMIT $2",
             (offset, limit))
         out = []
         for r in rows:
-            txs = self.drv.fetch(
+            txs = await self.drv.afetch(
                 "SELECT tx_hex FROM transactions WHERE block_hash = $1",
                 (r["hash"],))
             block = self._block_dict(r)
@@ -280,14 +372,14 @@ class PgChainState(StateViews):
     async def remove_blocks(self, from_block_id: int) -> None:
         """Reorg rollback (database.py:146-169), same dependent-tx filter
         as the sqlite backend."""
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT t.tx_hex FROM transactions t JOIN blocks b"
             " ON t.block_hash = b.hash WHERE b.id >= $1", (from_block_id,))
         txs = [tx_from_hex(r["tx_hex"], check_signatures=False) for r in rows]
         created = [tx.hash() for tx in txs]
         async with self._txn():
             for table in ("unspent_outputs",) + _GOV_TABLES:
-                self.drv.executemany(
+                await self.drv.aexecutemany(
                     f"DELETE FROM {table} WHERE tx_hash = $1",
                     [(h,) for h in created])
             created_set = set(created)
@@ -297,12 +389,19 @@ class PgChainState(StateViews):
                 if tx_input.tx_hash not in created_set
             ]
             await self._restore_spent_outputs(restore)
-            self.drv.executemany(
+            await self.drv.aexecutemany(
                 "DELETE FROM transactions WHERE tx_hash = $1",
                 [(h,) for h in created])
-            self.drv.execute(
+            await self.drv.aexecute(
                 "DELETE FROM blocks WHERE id >= $1", (from_block_id,))
-        self._index_rebuild()
+        # wholesale resync (restores don't update the index per row);
+        # under the writer lock so a concurrent accept committing between
+        # our fetches and the swap can't be clobbered by a stale snapshot
+        # (skip when this task owns an outer transaction — it already
+        # holds the non-reentrant lock and resyncs after its own exit)
+        if not self._owns_txn():
+            async with self._writer():
+                await self._aindex_rebuild()
 
     async def _restore_spent_outputs(self, inputs: List[TxInput]) -> None:
         for tx_input in inputs:
@@ -312,19 +411,19 @@ class PgChainState(StateViews):
                 continue
             out = src.outputs[tx_input.index]
             table = _OUTPUT_TABLE[out.output_type]
-            exists = self.drv.fetch(
+            exists = await self.drv.afetch(
                 f'SELECT 1 AS x FROM {table} WHERE tx_hash = $1'
                 f' AND "index" = $2', (tx_input.tx_hash, tx_input.index))
             if exists:
                 continue
             if table == "unspent_outputs":
-                self.drv.execute(
+                await self.drv.aexecute(
                     'INSERT INTO unspent_outputs (tx_hash, "index", address,'
                     " is_stake) VALUES ($1,$2,$3,$4)",
                     (tx_input.tx_hash, tx_input.index, out.address,
                      bool(out.is_stake)))
             else:
-                self.drv.execute(
+                await self.drv.aexecute(
                     f'INSERT INTO {table} (tx_hash, "index", address)'
                     " VALUES ($1,$2,$3)",
                     (tx_input.tx_hash, tx_input.index, out.address))
@@ -347,26 +446,28 @@ class PgChainState(StateViews):
                 [o.amount for o in tx.outputs],
                 _coins(fees),
             ))
-        self.drv.executemany(
-            "INSERT INTO transactions (block_hash, tx_hash, tx_hex,"
-            " inputs_addresses, outputs_addresses, outputs_amounts, fees)"
-            " VALUES ($1,$2,$3,$4,$5,$6,$7)"
-            " ON CONFLICT (tx_hash) DO UPDATE SET block_hash ="
-            " EXCLUDED.block_hash", rows)
+        async with self._write_guard():  # executemany is implicitly
+            # transactional in asyncpg; only exclusivity is needed
+            await self.drv.aexecutemany(
+                "INSERT INTO transactions (block_hash, tx_hash, tx_hex,"
+                " inputs_addresses, outputs_addresses, outputs_amounts, fees)"
+                " VALUES ($1,$2,$3,$4,$5,$6,$7)"
+                " ON CONFLICT (tx_hash) DO UPDATE SET block_hash ="
+                " EXCLUDED.block_hash", rows)
 
     async def get_transaction(self, tx_hash: str,
                               include_pending: bool = False) -> Optional[AnyTx]:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT tx_hex FROM transactions WHERE tx_hash = $1", (tx_hash,))
         if not rows and include_pending:
-            rows = self.drv.fetch(
+            rows = await self.drv.afetch(
                 "SELECT tx_hex FROM pending_transactions WHERE tx_hash = $1",
                 (tx_hash,))
         return tx_from_hex(rows[0]["tx_hex"], check_signatures=False) \
             if rows else None
 
     async def get_transaction_info(self, tx_hash: str) -> Optional[dict]:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT * FROM transactions WHERE tx_hash = $1", (tx_hash,))
         if not rows:
             return None
@@ -383,7 +484,7 @@ class PgChainState(StateViews):
 
     async def get_block_transactions(self, block_hash: str,
                                      hex_only: bool = False) -> List:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT tx_hex FROM transactions WHERE block_hash = $1",
             (block_hash,))
         if hex_only:
@@ -392,13 +493,13 @@ class PgChainState(StateViews):
 
     async def resolve_output_address(self, tx_hash: str,
                                      index: int) -> Optional[str]:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT outputs_addresses FROM transactions WHERE tx_hash = $1",
             (tx_hash,))
         if rows:
             addresses = list(rows[0]["outputs_addresses"])
             return addresses[index] if index < len(addresses) else None
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT tx_hex FROM pending_transactions WHERE tx_hash = $1",
             (tx_hash,))
         if not rows:
@@ -408,13 +509,13 @@ class PgChainState(StateViews):
 
     async def get_output_amount(self, tx_hash: str,
                                 index: int) -> Optional[int]:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT outputs_amounts FROM transactions WHERE tx_hash = $1",
             (tx_hash,))
         if rows:
             amounts = list(rows[0]["outputs_amounts"])
             return amounts[index] if index < len(amounts) else None
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT tx_hex FROM pending_transactions WHERE tx_hash = $1",
             (tx_hash,))
         if not rows:
@@ -431,19 +532,19 @@ class PgChainState(StateViews):
         ]
         fees = await self.tx_fees(tx)
         async with self._txn():
-            self.drv.execute(
+            await self.drv.aexecute(
                 "INSERT INTO pending_transactions (tx_hash, tx_hex,"
                 " inputs_addresses, fees, propagation_time)"
                 " VALUES ($1,$2,$3,$4,$5)",
                 (tx.hash(), tx.hex(), inputs_addresses, _coins(fees),
                  _utc(now_ts())))
-            self.drv.executemany(
+            await self.drv.aexecutemany(
                 'INSERT INTO pending_spent_outputs (tx_hash, "index")'
                 " VALUES ($1,$2)",
                 [(i.tx_hash, i.index) for i in tx.inputs])
 
-    def _pending_decoded(self) -> Dict[str, Tx]:
-        rows = self.drv.fetch(
+    async def _pending_decoded(self) -> Dict[str, Tx]:
+        rows = await self.drv.afetch(
             "SELECT tx_hash, tx_hex FROM pending_transactions")
         return {
             r["tx_hash"]: tx_from_hex(r["tx_hex"], check_signatures=False)
@@ -451,7 +552,7 @@ class PgChainState(StateViews):
         }
 
     async def pending_transaction_exists(self, tx_hash: str) -> bool:
-        return bool(self.drv.fetch(
+        return bool(await self.drv.afetch(
             "SELECT 1 AS x FROM pending_transactions WHERE tx_hash = $1",
             (tx_hash,)))
 
@@ -468,7 +569,7 @@ class PgChainState(StateViews):
         fees; a pg-backed node reproduces the reference's block-building
         choices instead.  Consensus is unaffected (fees in accepted
         blocks are recomputed from tx amounts)."""
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT tx_hex FROM pending_transactions ORDER BY"
             " fees / LENGTH(tx_hex) DESC, tx_hash")
         out, total = [], 0
@@ -485,7 +586,7 @@ class PgChainState(StateViews):
                                                hashes: List[str]) -> List[str]:
         out = []
         for h in hashes:
-            rows = self.drv.fetch(
+            rows = await self.drv.afetch(
                 "SELECT tx_hex FROM pending_transactions WHERE tx_hash = $1",
                 (h,))
             if rows:
@@ -493,7 +594,7 @@ class PgChainState(StateViews):
         return out
 
     async def get_pending_spent_outpoints(self) -> set:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             'SELECT tx_hash, "index" FROM pending_spent_outputs')
         return {(r["tx_hash"], r["index"]) for r in rows}
 
@@ -506,7 +607,7 @@ class PgChainState(StateViews):
         for i in range(0, len(hashes), 500):
             chunk = hashes[i:i + 500]
             ph = ",".join(f"${j + 1}" for j in range(len(chunk)))
-            rows = self.drv.fetch(
+            rows = await self.drv.afetch(
                 "SELECT tx_hex FROM pending_transactions"
                 f" WHERE tx_hash IN ({ph})", chunk)
             spent = []
@@ -515,26 +616,27 @@ class PgChainState(StateViews):
                 if not tx.is_coinbase:
                     spent.extend((inp.tx_hash, inp.index) for inp in tx.inputs)
             if spent:
-                self.drv.executemany(
+                await self.drv.aexecutemany(
                     "DELETE FROM pending_spent_outputs"
                     ' WHERE tx_hash = $1 AND "index" = $2', spent)
-            self.drv.execute(
+            await self.drv.aexecute(
                 f"DELETE FROM pending_transactions WHERE tx_hash IN ({ph})",
                 chunk)
 
     async def remove_pending_transactions(self) -> None:
         async with self._txn():
-            self.drv.execute("DELETE FROM pending_transactions")
-            self.drv.execute("DELETE FROM pending_spent_outputs")
+            await self.drv.aexecute("DELETE FROM pending_transactions")
+            await self.drv.aexecute("DELETE FROM pending_spent_outputs")
 
     async def get_pending_transactions_count(self) -> int:
-        return self.drv.fetch(
-            "SELECT COUNT(*) AS c FROM pending_transactions")[0]["c"]
+        rows = await self.drv.afetch(
+            "SELECT COUNT(*) AS c FROM pending_transactions")
+        return rows[0]["c"]
 
     async def get_need_propagate_transactions(self,
                                               older_than: int = 300) -> List[str]:
         """Piggyback re-propagation queue (database.py:188-207)."""
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT tx_hex FROM pending_transactions"
             " WHERE propagation_time < $1",
             (_utc(now_ts() - older_than),))
@@ -542,9 +644,10 @@ class PgChainState(StateViews):
 
     async def update_pending_transaction_propagation(self,
                                                      tx_hash: str) -> None:
-        self.drv.execute(
-            "UPDATE pending_transactions SET propagation_time = $1"
-            " WHERE tx_hash = $2", (_utc(now_ts()), tx_hash))
+        async with self._write_guard():
+            await self.drv.aexecute(
+                "UPDATE pending_transactions SET propagation_time = $1"
+                " WHERE tx_hash = $2", (_utc(now_ts()), tx_hash))
 
     # --------------------------------------------------------------- UTXO --
 
@@ -560,38 +663,43 @@ class PgChainState(StateViews):
             for index, out in enumerate(tx.outputs):
                 table = _OUTPUT_TABLE[out.output_type]
                 by_table.setdefault(table, []).append((h, index, out))
-        for table, entries in by_table.items():
-            self.drv.executemany(
-                f'DELETE FROM {table} WHERE tx_hash = $1 AND "index" = $2',
-                [(h, i) for h, i, _ in entries])
-            if table == "unspent_outputs":
-                self.drv.executemany(
-                    'INSERT INTO unspent_outputs (tx_hash, "index",'
-                    " address, is_stake) VALUES ($1,$2,$3,$4)",
-                    [(h, i, o.address, bool(o.is_stake))
-                     for h, i, o in entries])
-            else:
-                self.drv.executemany(
-                    f'INSERT INTO {table} (tx_hash, "index", address)'
-                    " VALUES ($1,$2,$3)",
-                    [(h, i, o.address) for h, i, o in entries])
-            self._index_add(table, [(h, i) for h, i, _ in entries])
+        async with self._txn():
+            for table, entries in by_table.items():
+                await self.drv.aexecutemany(
+                    f'DELETE FROM {table} WHERE tx_hash = $1'
+                    ' AND "index" = $2',
+                    [(h, i) for h, i, _ in entries])
+                if table == "unspent_outputs":
+                    await self.drv.aexecutemany(
+                        'INSERT INTO unspent_outputs (tx_hash, "index",'
+                        " address, is_stake) VALUES ($1,$2,$3,$4)",
+                        [(h, i, o.address, bool(o.is_stake))
+                         for h, i, o in entries])
+                else:
+                    await self.drv.aexecutemany(
+                        f'INSERT INTO {table} (tx_hash, "index", address)'
+                        " VALUES ($1,$2,$3)",
+                        [(h, i, o.address) for h, i, o in entries])
+                self._index_add(table, [(h, i) for h, i, _ in entries])
 
     async def remove_outputs(self, txs: Sequence[AnyTx]) -> None:
         """Spend inputs from the table their tx type targets
         (database.py:589-622)."""
-        for tx in txs:
-            if tx.is_coinbase:
-                continue
-            table = _INPUT_TABLE.get(tx.transaction_type, "unspent_outputs")
-            self.drv.executemany(
-                f'DELETE FROM {table} WHERE tx_hash = $1 AND "index" = $2',
-                [(i.tx_hash, i.index) for i in tx.inputs])
-            self._index_remove(table, [i.outpoint for i in tx.inputs])
+        async with self._txn():
+            for tx in txs:
+                if tx.is_coinbase:
+                    continue
+                table = _INPUT_TABLE.get(tx.transaction_type,
+                                         "unspent_outputs")
+                await self.drv.aexecutemany(
+                    f'DELETE FROM {table} WHERE tx_hash = $1'
+                    ' AND "index" = $2',
+                    [(i.tx_hash, i.index) for i in tx.inputs])
+                self._index_remove(table, [i.outpoint for i in tx.inputs])
 
     async def get_unspent_outpoints(self,
                                     table: str = "unspent_outputs") -> set:
-        rows = self.drv.fetch(f'SELECT tx_hash, "index" FROM {table}')
+        rows = await self.drv.afetch(f'SELECT tx_hash, "index" FROM {table}')
         return {(r["tx_hash"], r["index"]) for r in rows}
 
     async def outpoints_exist(self, outpoints: List[Tuple[str, int]],
@@ -618,14 +726,14 @@ class PgChainState(StateViews):
             placeholders = ",".join(
                 f"(${2 * j + 1},${2 * j + 2})" for j in range(len(chunk)))
             params = [v for o in chunk for v in o]
-            rows = self.drv.fetch(
+            rows = await self.drv.afetch(
                 f'SELECT tx_hash, "index" FROM {table} WHERE'
                 f' (tx_hash, "index") IN (VALUES {placeholders})', params)
             found.update((r["tx_hash"], r["index"]) for r in rows)
         return [tuple(o) in found for o in outpoints]
 
     async def get_table_outpoints_hash(self, table: str) -> str:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             f'SELECT tx_hash, "index" FROM {table}'
             ' ORDER BY tx_hash, "index"')
         h = hashlib.sha256()
@@ -651,7 +759,7 @@ class PgChainState(StateViews):
 
     async def get_spendable_outputs(self, address: str,
                                     check_pending_txs: bool = False) -> List[TxInput]:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             'SELECT u.tx_hash, u."index", u.address, u.is_stake,'
             " t.outputs_amounts FROM unspent_outputs u"
             " JOIN transactions t ON t.tx_hash = u.tx_hash"
@@ -669,7 +777,7 @@ class PgChainState(StateViews):
 
     async def get_stake_outputs(self, address: str,
                                 check_pending_txs: bool = False) -> List[TxInput]:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             'SELECT u.tx_hash, u."index", u.address, u.is_stake,'
             " t.outputs_amounts FROM unspent_outputs u"
             " JOIN transactions t ON t.tx_hash = u.tx_hash"
@@ -687,7 +795,7 @@ class PgChainState(StateViews):
 
     async def get_address_transactions(self, address: str, limit: int = 50,
                                        offset: int = 0) -> List[dict]:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT t.tx_hash, b.id AS block_id FROM transactions t"
             " JOIN blocks b ON b.hash = t.block_hash"
             " WHERE $1 = ANY(inputs_addresses)"
@@ -703,7 +811,7 @@ class PgChainState(StateViews):
                              pending: Optional[set] = None) -> List[Tuple[str, int]]:
         """(address, registered_at block timestamp) per registration
         output (same contract as storage.py get_registered)."""
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             f'SELECT g.tx_hash, g."index", g.address, b.timestamp AS ts'
             f" FROM {table} g"
             " LEFT JOIN transactions t ON t.tx_hash = g.tx_hash"
@@ -723,7 +831,7 @@ class PgChainState(StateViews):
                                       check_pending_txs: bool = False) -> List[dict]:
         """Standing votes FOR ``recipient`` (storage.py
         get_ballot_by_recipient contract; reference database.py:939-1063)."""
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             f'SELECT g.tx_hash, g."index", t.outputs_amounts,'
             f" t.inputs_addresses FROM {table} g"
             f" JOIN transactions t ON t.tx_hash = g.tx_hash"
@@ -748,7 +856,7 @@ class PgChainState(StateViews):
     async def _all_ballot_rows(self, table: str,
                                check_pending_txs: bool = False,
                                pending: Optional[set] = None) -> List[dict]:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             f'SELECT g.tx_hash, g."index", g.address AS recipient,'
             f" t.outputs_amounts, t.inputs_addresses FROM {table} g"
             f" JOIN transactions t ON t.tx_hash = g.tx_hash")
@@ -773,7 +881,7 @@ class PgChainState(StateViews):
 
     async def _outpoint_listing(self, table: str, address: str,
                                 check_pending_txs: bool) -> List[Tuple[str, int]]:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             f'SELECT tx_hash, "index" FROM {table} WHERE address = $1',
             (address,))
         pending = (await self.get_pending_spent_outpoints()) \
@@ -806,7 +914,7 @@ class PgChainState(StateViews):
             return {}
         out: Dict[str, Decimal] = {a: Decimal(0) for a in addresses}
         placeholders = ",".join(f"${i + 1}" for i in range(len(addresses)))
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             'SELECT u.tx_hash, u."index", u.address, t.outputs_amounts'
             " FROM unspent_outputs u JOIN transactions t"
             " ON t.tx_hash = u.tx_hash"
@@ -822,7 +930,7 @@ class PgChainState(StateViews):
             out[r["address"]] += Decimal(r["amount"]) / SMALLEST
         if check_pending_txs:
             want = set(addresses)
-            for tx in self._pending_decoded().values():
+            for tx in (await self._pending_decoded()).values():
                 for o in tx.outputs:
                     if o.is_stake and o.address in want:
                         out[o.address] += Decimal(o.amount) / SMALLEST
@@ -839,7 +947,7 @@ class PgChainState(StateViews):
         if is_stake is not None and table == "unspent_outputs":
             sql += " AND g.is_stake = $2"
             params.append(bool(is_stake))
-        rows = self.drv.fetch(sql, params)
+        rows = await self.drv.afetch(sql, params)
         pending = (await self.get_pending_spent_outpoints()) \
             if check_pending_txs else set()
         return [
@@ -853,7 +961,7 @@ class PgChainState(StateViews):
                           offset: int = 0, limit: int = 100) -> List[dict]:
         """Paged ballot listing (storage.py get_ballots contract)."""
         if recipient is not None:
-            rows = self.drv.fetch(
+            rows = await self.drv.afetch(
                 f'SELECT g.tx_hash, g."index", g.address,'
                 f" t.outputs_amounts, t.inputs_addresses FROM {table} g"
                 f" JOIN transactions t ON t.tx_hash = g.tx_hash"
@@ -861,7 +969,7 @@ class PgChainState(StateViews):
                 f" LIMIT $2 OFFSET $3",
                 (recipient, limit, offset))
         else:
-            rows = self.drv.fetch(
+            rows = await self.drv.afetch(
                 f'SELECT g.tx_hash, g."index", g.address,'
                 f" t.outputs_amounts, t.inputs_addresses FROM {table} g"
                 f" JOIN transactions t ON t.tx_hash = g.tx_hash"
@@ -883,7 +991,7 @@ class PgChainState(StateViews):
 
     async def get_transaction_block_timestamp(self,
                                               tx_hash: str) -> Optional[int]:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT b.timestamp AS ts FROM transactions t JOIN blocks b ON"
             " b.hash = t.block_hash WHERE t.tx_hash = $1", (tx_hash,))
         return _epoch(rows[0]["ts"]) if rows else None
@@ -894,14 +1002,14 @@ class PgChainState(StateViews):
                                    address: Optional[str] = None) -> Optional[dict]:
         """Explorer-style decoded transaction (storage.py
         get_nice_transaction contract; reference database.py:1606-1654)."""
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT t.tx_hash, t.tx_hex, t.inputs_addresses, t.block_hash,"
             " b.id AS block_no, b.timestamp AS block_ts FROM"
             " transactions t JOIN blocks b ON b.hash = t.block_hash"
             " WHERE t.tx_hash = $1", (tx_hash,))
         is_confirm = bool(rows)
         if not rows:
-            rows = self.drv.fetch(
+            rows = await self.drv.afetch(
                 "SELECT tx_hash, tx_hex, inputs_addresses FROM"
                 " pending_transactions WHERE tx_hash = $1", (tx_hash,))
         if not rows:
@@ -966,13 +1074,13 @@ class PgChainState(StateViews):
         return out
 
     async def get_block_transaction_hashes(self, block_hash: str) -> List[str]:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT tx_hash FROM transactions WHERE block_hash = $1",
             (block_hash,))
         return [r["tx_hash"] for r in rows]
 
     async def get_address_pending_transactions(self, address: str) -> List[Tx]:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT tx_hex, inputs_addresses FROM pending_transactions")
         out = []
         for r in rows:
@@ -984,7 +1092,7 @@ class PgChainState(StateViews):
 
     async def get_address_pending_spent_outpoints(
             self, address: str) -> List[Tuple[str, int]]:
-        rows = self.drv.fetch(
+        rows = await self.drv.afetch(
             "SELECT tx_hex, inputs_addresses FROM pending_transactions")
         out = []
         for r in rows:
@@ -1002,8 +1110,8 @@ class PgChainState(StateViews):
         log (reference create_unspent_outputs.py + database.py:846-862)."""
         async with self._txn():
             for table in ("unspent_outputs",) + _GOV_TABLES:
-                self.drv.execute(f"DELETE FROM {table}")
-            rows = self.drv.fetch(
+                await self.drv.aexecute(f"DELETE FROM {table}")
+            rows = await self.drv.afetch(
                 "SELECT t.tx_hex FROM transactions t JOIN blocks b ON"
                 " b.hash = t.block_hash ORDER BY b.id")
             txs = [tx_from_hex(r["tx_hex"], check_signatures=False)
@@ -1011,7 +1119,13 @@ class PgChainState(StateViews):
             for tx in txs:
                 await self.add_transaction_outputs([tx])
                 await self.remove_outputs([tx])
-        self._index_rebuild()
+        if not self._owns_txn():
+            # inside a replay transaction the owning scope resyncs the
+            # index after its rollback; here, resync under the writer
+            # lock so a concurrent commit can't be clobbered by a stale
+            # snapshot swap
+            async with self._writer():
+                await self._aindex_rebuild()
 
 
 def _row_keys(r) -> set:

@@ -25,6 +25,7 @@ passed in), row-value IN lists built with explicit placeholders.
 
 from __future__ import annotations
 
+import asyncio
 import datetime
 import json
 import re
@@ -51,26 +52,47 @@ def _epoch(dt) -> int:
 class AsyncpgDriver:
     """One asyncpg connection on a private loop thread, sync facade.
 
-    Single-connection by design: the node's storage access is already
-    serialized through its event loop (the sqlite backend is one
-    connection too), and block acceptance wraps BEGIN/COMMIT around the
-    connection — a pool would break that transaction affinity.
+    Single-connection by design: block acceptance wraps BEGIN/COMMIT
+    around the connection (a pool would break that transaction
+    affinity).  asyncpg allows ONE operation in flight per connection,
+    so every facade call — sync or awaitable — runs under a per-
+    statement lock on the driver loop; transaction-scope exclusivity
+    (no foreign writer joining an open BEGIN) is the storage layer's
+    job (PgChainState's writer lock).
 
-    Each call blocks the calling thread for one driver round trip —
-    the same short-synchronous-call model the sqlite backend uses, but
-    with a network RTT attached.  The storage layer batches its hot
-    paths into executemany/JOIN shapes to keep statements-per-block
-    low; deployments should colocate the node with the database (the
+    Two call styles:
+
+    * ``afetch``/``aexecute``/... — awaitable from the node's event
+      loop: the coroutine runs on the driver thread's loop and the
+      caller awaits a wrapped future, so a network round trip never
+      blocks the node (gossip, heartbeats and other endpoints keep
+      being served during storage I/O).  This is what PgChainState's
+      async methods use.
+    * ``fetch``/``execute``/... — synchronous, blocking the calling
+      thread for one round trip; for CLI tools (reindex) and tests.
+
+    The storage layer additionally batches its hot paths into
+    executemany/JOIN shapes to keep statements-per-block low;
+    deployments should still colocate the node with the database (the
     reference's asyncpg setup assumes the same).
     """
 
     def __init__(self, dsn: str):
-        import asyncio
-
+        self._dsn = dsn
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(
             target=self._loop.run_forever, daemon=True, name="pg-driver")
         self._thread.start()
+        # per-statement serialization: asyncpg raises InterfaceError on
+        # a second in-flight operation; the lock lives on (binds to) the
+        # driver loop where every operation runs
+        self._oplock = None
+        # transaction state, mutated ONLY on the driver loop inside the
+        # op lock (see the _do_* helpers): _txn_open tracks an open
+        # BEGIN; _txn_lost poisons writes after a mid-transaction
+        # connection loss until the owner rolls back.
+        self._txn_open = False
+        self._txn_lost = False
         self._conn = self._call(self._connect(dsn))
 
     async def _connect(self, dsn: str):
@@ -78,30 +100,132 @@ class AsyncpgDriver:
 
         return await asyncpg.connect(dsn)
 
-    def _call(self, coro):
-        import asyncio
+    async def _ensure_conn(self):
+        """Reconnect once if the server dropped the connection (restart,
+        idle timeout) — the reference's pool does the same implicitly
+        (database.py:36-43).  Runs under the op lock, so no statement is
+        in flight while the connection is swapped.
 
+        A drop MID-TRANSACTION poisons writes (``_txn_lost``) rather
+        than raising at whoever happens to touch the connection next:
+        the server already rolled the transaction back, so the OWNER's
+        next write/COMMIT must fail loudly (a COMMIT on the fresh
+        connection would be a silent no-op), while incidental readers
+        are fine on the fresh connection."""
+        if self._conn.is_closed():
+            import logging
+
+            logging.getLogger("upow_tpu.state").warning(
+                "pg connection lost; reconnecting")
+            self._conn = await self._connect(self._dsn)
+            if self._txn_open:
+                self._txn_open = False
+                self._txn_lost = True
+
+    def _check_not_lost(self):
+        if self._txn_lost:
+            raise ConnectionError(
+                "pg connection was lost mid-transaction; the open "
+                "transaction was rolled back server-side — roll back "
+                "and retry")
+
+    # the _do_* helpers run on the driver loop under the op lock, so
+    # transaction-state reads/writes are race-free
+
+    async def _do_fetch(self, sql, args):
+        return await self._conn.fetch(sql, *args)
+
+    async def _do_execute(self, sql, args):
+        self._check_not_lost()
+        return await self._conn.execute(sql, *args)
+
+    async def _do_executemany(self, sql, rows):
+        self._check_not_lost()
+        return await self._conn.executemany(sql, rows)
+
+    async def _do_begin(self):
+        self._check_not_lost()
+        await self._conn.execute("BEGIN")
+        self._txn_open = True
+
+    async def _do_commit(self):
+        self._check_not_lost()
+        await self._conn.execute("COMMIT")
+        self._txn_open = False
+
+    async def _do_rollback(self):
+        # clears the poison: nothing is left to roll back server-side
+        # after a connection loss, and the caller has now observed it
+        try:
+            if not self._txn_lost:
+                await self._conn.execute("ROLLBACK")
+        finally:
+            self._txn_open = False
+            self._txn_lost = False
+
+    def _call(self, coro):
         return asyncio.run_coroutine_threadsafe(coro, self._loop).result()
 
+    def _submit(self, coro):
+        """Awaitable-from-any-loop handle for a coroutine running on the
+        driver thread's loop."""
+        return asyncio.wrap_future(
+            asyncio.run_coroutine_threadsafe(coro, self._loop))
+
+    async def _locked(self, op):
+        if self._oplock is None:
+            self._oplock = asyncio.Lock()
+        async with self._oplock:
+            await self._ensure_conn()
+            return await op()
+
+    # -- sync facade (CLI tools, tests) --
+
     def fetch(self, sql: str, args: Sequence[Any] = ()) -> List[Any]:
-        return self._call(self._conn.fetch(sql, *args))
+        return self._call(self._locked(lambda: self._do_fetch(sql, args)))
 
     def execute(self, sql: str, args: Sequence[Any] = ()) -> None:
-        self._call(self._conn.execute(sql, *args))
+        self._call(self._locked(lambda: self._do_execute(sql, args)))
 
     def executemany(self, sql: str, rows: Iterable[Sequence[Any]]) -> None:
         rows = list(rows)
         if rows:
-            self._call(self._conn.executemany(sql, rows))
+            self._call(self._locked(lambda: self._do_executemany(sql, rows)))
 
     def begin(self) -> None:
-        self.execute("BEGIN")
+        self._call(self._locked(self._do_begin))
 
     def commit(self) -> None:
-        self.execute("COMMIT")
+        self._call(self._locked(self._do_commit))
 
     def rollback(self) -> None:
-        self.execute("ROLLBACK")
+        self._call(self._locked(self._do_rollback))
+
+    # -- awaitable facade (the node's event loop) --
+
+    async def afetch(self, sql: str, args: Sequence[Any] = ()) -> List[Any]:
+        return await self._submit(
+            self._locked(lambda: self._do_fetch(sql, args)))
+
+    async def aexecute(self, sql: str, args: Sequence[Any] = ()) -> None:
+        await self._submit(
+            self._locked(lambda: self._do_execute(sql, args)))
+
+    async def aexecutemany(self, sql: str,
+                           rows: Iterable[Sequence[Any]]) -> None:
+        rows = list(rows)
+        if rows:
+            await self._submit(
+                self._locked(lambda: self._do_executemany(sql, rows)))
+
+    async def abegin(self) -> None:
+        await self._submit(self._locked(self._do_begin))
+
+    async def acommit(self) -> None:
+        await self._submit(self._locked(self._do_commit))
+
+    async def arollback(self) -> None:
+        await self._submit(self._locked(self._do_rollback))
 
     def close(self) -> None:
         try:
@@ -262,3 +386,26 @@ class MockPgDriver:
 
     def close(self) -> None:
         self.db.close()
+
+    # awaitable facade: same semantics, sqlite is in-process so the
+    # "await" is immediate — what matters is interface parity with
+    # AsyncpgDriver so PgChainState's SQL runs identically on both
+
+    async def afetch(self, sql: str, args: Sequence[Any] = ()) -> List[dict]:
+        return self.fetch(sql, args)
+
+    async def aexecute(self, sql: str, args: Sequence[Any] = ()) -> None:
+        self.execute(sql, args)
+
+    async def aexecutemany(self, sql: str,
+                           rows: Iterable[Sequence[Any]]) -> None:
+        self.executemany(sql, rows)
+
+    async def abegin(self) -> None:
+        self.begin()
+
+    async def acommit(self) -> None:
+        self.commit()
+
+    async def arollback(self) -> None:
+        self.rollback()

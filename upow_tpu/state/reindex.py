@@ -28,17 +28,9 @@ async def check_replay_pg(state) -> tuple:
     """(before, after) full-state fingerprints, replaying inside one
     rolled-back transaction — the live tables are never modified."""
     before = await state.get_full_state_hash()
-    state.drv.begin()
-    state._in_atomic = True  # rebuild_utxos skips its own txn wrapper
-    try:
+    async with state.replay_transaction():
         await state.rebuild_utxos()
         after = await state.get_full_state_hash()
-    finally:
-        state.drv.rollback()
-        state._in_atomic = False
-        # the replay rebuilt the in-memory device index from rows the
-        # rollback just discarded — resync it to the live tables
-        state._index_rebuild()
     return before, after
 
 

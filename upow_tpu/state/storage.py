@@ -223,7 +223,7 @@ class ChainState(StateViews):
             for key in [k for k in self._amount_cache if k[0] in gone]:
                 del self._amount_cache[key]
 
-    def _pending_decoded(self) -> Dict[str, Tx]:
+    async def _pending_decoded(self) -> Dict[str, Tx]:
         # (count, max rowid) detects writes from OTHER connections (the
         # wallet CLI's direct-mempool fallback shares the sqlite file):
         # inserts bump max rowid, deletes drop the count.  The local
@@ -963,7 +963,7 @@ class ChainState(StateViews):
             out[r["address"]] += Decimal(r["amount"]) / SMALLEST
         if check_pending_txs:
             want = set(addresses)
-            for tx in self._pending_decoded().values():
+            for tx in (await self._pending_decoded()).values():
                 for o in tx.outputs:
                     if o.is_stake and o.address in want:
                         out[o.address] += Decimal(o.amount) / SMALLEST

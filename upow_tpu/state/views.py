@@ -105,7 +105,7 @@ class StateViews:
         balance = sum(i.amount for i in await self.get_spendable_outputs(
             address, check_pending_txs=check_pending_txs))
         if check_pending_txs:
-            for tx in self._pending_decoded().values():
+            for tx in (await self._pending_decoded()).values():
                 for out in tx.outputs:
                     if out.address == address and out.output_type == OutputType.REGULAR:
                         balance += out.amount
@@ -119,7 +119,7 @@ class StateViews:
             address, check_pending_txs=check_pending_txs))
         stake = Decimal(stake) / SMALLEST
         if check_pending_txs:
-            for tx in self._pending_decoded().values():
+            for tx in (await self._pending_decoded()).values():
                 for out in tx.outputs:
                     if out.address == address and out.is_stake:
                         stake += Decimal(out.amount) / SMALLEST
@@ -264,14 +264,14 @@ class StateViews:
 
     async def get_pending_stake_transactions(self, address: str) -> List[Tx]:
         """Pending txs that stake for this address (database.py:1157-1172)."""
-        return [tx for tx in self._pending_decoded().values()
+        return [tx for tx in (await self._pending_decoded()).values()
                 if any(o.address == address and o.is_stake for o in tx.outputs)]
 
     async def get_pending_vote_as_delegate_transactions(self, address: str) -> List[Tx]:
         """Pending VOTE_AS_DELEGATE txs whose first input is this address
         (database.py:1174-1187)."""
         out = []
-        for tx in self._pending_decoded().values():
+        for tx in (await self._pending_decoded()).values():
             if tx.transaction_type != TransactionType.VOTE_AS_DELEGATE or tx.is_coinbase:
                 continue
             if not tx.inputs:
