@@ -65,8 +65,8 @@ class Cluster:
         self.servers = []
         self.clients = []
 
-    async def add_node(self, name: str) -> tuple:
-        node = Node(make_config(self.tmp_path, name))
+    async def add_node(self, name: str, state=None) -> tuple:
+        node = Node(make_config(self.tmp_path, name), state=state)
         server = TestServer(node.app)
         await server.start_server()
         client = TestClient(server)
@@ -917,9 +917,16 @@ def test_randomized_churn_soak(tmp_path, keys, monkeypatch):
                         lambda *_a, **_k: Decimal("1.0"))
 
     async def scenario(cluster):
+        from upow_tpu.state.pg import PgChainState
+        from upow_tpu.state.pgdriver import MockPgDriver
+
         nodes, clients = [], []
         for name in ("a", "b", "c"):
-            n, c = await cluster.add_node(name)
+            # node c runs the PostgreSQL backend (mock driver) — the
+            # cluster churn must converge identically across backends
+            state = PgChainState(driver=MockPgDriver()) if name == "c" \
+                else None
+            n, c = await cluster.add_node(name, state=state)
             # fork detection only runs when the chain is LONGER than the
             # reorg window (reference main.py:167) — keep it smaller than
             # the funding prefix below
