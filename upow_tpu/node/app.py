@@ -91,7 +91,8 @@ class Node:
         self.manager = BlockManager(
             self.state, sig_backend=self.config.device.sig_backend,
             verify_pad_block=self.config.device.verify_pad_block,
-            verify_device_timeout=self.config.device.verify_device_timeout)
+            verify_device_timeout=self.config.device.verify_device_timeout,
+            verify_mesh_devices=self.config.device.mesh_devices)
         self.peers = PeerBook(self.config.node)
         self.ip_filter = IpFilter(self.config.node.ip_config_file)
         from .ratelimit import RateLimiter
@@ -333,6 +334,7 @@ class Node:
                 verify_pad_block=self.config.device.verify_pad_block,
                 verify_device_timeout=(
                     self.config.device.verify_device_timeout),
+                verify_mesh_devices=self.config.device.mesh_devices,
             ).verify_pending(tx, sig_backend=self.config.device.sig_backend)
         except Exception as e:
             log.info("tx verify error %s: %s", tx_hash, e)
@@ -1170,7 +1172,8 @@ class Node:
             self.manager.state, is_syncing=True,
             verify_pad_block=self.config.device.verify_pad_block,
             verify_device_timeout=self.config.device.verify_device_timeout,
-            tx_overlay=overlay)
+            tx_overlay=overlay,
+            verify_mesh_devices=self.config.device.mesh_devices)
         checks = []
         for _block, txs, _cb in parsed:
             for tx in txs:
@@ -1186,7 +1189,8 @@ class Node:
         verdicts = await run_sig_checks_async(
             checks, backend=self.config.device.sig_backend,
             pad_block=self.config.device.verify_pad_block,
-            device_timeout=self.config.device.verify_device_timeout)
+            device_timeout=self.config.device.verify_device_timeout,
+            mesh_devices=self.config.device.mesh_devices)
         return dict(zip(checks, verdicts))
 
     # --------------------------------------------------------- app build --

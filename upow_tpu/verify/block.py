@@ -77,11 +77,15 @@ class BlockManager:
 
     def __init__(self, state: ChainState, sig_backend: str = "auto",
                  verify_pad_block: int = 128,
-                 verify_device_timeout: float = 240.0):
+                 verify_device_timeout: float = 240.0,
+                 verify_mesh_devices: int = 1):
         self.state = state
         self.sig_backend = sig_backend
         self.verify_pad_block = verify_pad_block
         self.verify_device_timeout = verify_device_timeout
+        # DP-shard the device verify batch over a mesh (SURVEY §2.3):
+        # 0 = all visible devices, 1 = single device, N = first N
+        self.verify_mesh_devices = verify_mesh_devices
         self._difficulty_cache: Optional[Tuple[Decimal, dict]] = None
         self._inode_cache: Optional[List[dict]] = None
         self._inode_cache_time = 0.0
@@ -186,7 +190,8 @@ class BlockManager:
         verifier = TxVerifier(
             self.state, is_syncing=self.is_syncing,
             verify_pad_block=self.verify_pad_block,
-            verify_device_timeout=self.verify_device_timeout)
+            verify_device_timeout=self.verify_device_timeout,
+            verify_mesh_devices=self.verify_mesh_devices)
         all_checks: List[tuple] = []
         for tx in transactions:
             if not await verifier.rules_ok(tx, check_double_spend=False):
@@ -201,7 +206,8 @@ class BlockManager:
                 all_checks, backend=self.sig_backend,
                 pad_block=self.verify_pad_block,
                 device_timeout=self.verify_device_timeout,
-                precomputed=self.page_sig_verdicts)):
+                precomputed=self.page_sig_verdicts,
+                mesh_devices=self.verify_mesh_devices)):
             errors.append("signature verification failed")
             return False
 

@@ -107,7 +107,8 @@ async def run_sig_checks_async(checks: Sequence[tuple],
                                backend: str = "auto",
                                pad_block: int = 128,
                                device_timeout: float = 240.0,
-                               precomputed=None) -> List[bool]:
+                               precomputed=None,
+                               mesh_devices: int = 1) -> List[bool]:
     """Executor-wrapped :func:`run_sig_checks`: the device dispatch (and
     its hang time-box) must not block the node's event loop — the C++
     host batch and ctypes both release the GIL, so this also overlaps
@@ -119,7 +120,43 @@ async def run_sig_checks_async(checks: Sequence[tuple],
         None, functools.partial(run_sig_checks, checks, backend=backend,
                                 pad_block=pad_block,
                                 device_timeout=device_timeout,
-                                precomputed=precomputed))
+                                precomputed=precomputed,
+                                mesh_devices=mesh_devices))
+
+
+_VERIFY_MESH = {}  # mesh_devices -> Mesh | None, built once per process
+_VERIFY_MESH_LOCK = threading.Lock()  # intake + block verify race this
+# cache from different executor threads
+
+
+def _verify_mesh(mesh_devices: int):
+    """DP mesh for the device verify dispatch (SURVEY §2.3): 0 = all
+    visible devices, 1 = single device (no mesh), N = first N.  On a
+    one-chip host this is always None — the batch stays resident on the
+    single device with no partitioning overhead.  The 'complete' kernel
+    variant has no mesh wiring (p256 partitions the jac ladder only);
+    it keeps the unsharded dispatch rather than poisoning the device
+    path."""
+    if mesh_devices == 1:
+        return None
+    from ..crypto import p256
+
+    if p256.PALLAS_KERNEL == "complete":
+        return None
+    with _VERIFY_MESH_LOCK:
+        if mesh_devices not in _VERIFY_MESH:
+            import jax
+
+            devices = jax.devices()
+            n = len(devices) if mesh_devices == 0 else min(
+                mesh_devices, len(devices))
+            if n <= 1:
+                _VERIFY_MESH[mesh_devices] = None
+            else:
+                from ..parallel.mesh import make_mesh
+
+                _VERIFY_MESH[mesh_devices] = make_mesh(devices[:n])
+        return _VERIFY_MESH[mesh_devices]
 
 
 _SIG_VERDICTS: "OrderedDict[tuple, bool]" = OrderedDict()
@@ -162,7 +199,8 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
                    pad_block: int = 128,
                    device_timeout: float = 240.0,
                    use_cache: bool = True,
-                   precomputed=None) -> List[bool]:
+                   precomputed=None,
+                   mesh_devices: int = 1) -> List[bool]:
     """Verify deferred checks in one (or two) batched device calls.
 
     Pass 1 verifies against the raw-bytes digest; only failures re-try the
@@ -205,7 +243,7 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
             rest = run_sig_checks(
                 [checks[i] for i in rest_idx], backend=backend,
                 pad_block=pad_block, device_timeout=device_timeout,
-                use_cache=use_cache)
+                use_cache=use_cache, mesh_devices=mesh_devices)
             for i, v in zip(rest_idx, rest):
                 out_pre[i] = v
         return out_pre  # type: ignore[return-value]
@@ -228,7 +266,7 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
             fresh = run_sig_checks(
                 miss_checks, backend=resolved,
                 pad_block=pad_block, device_timeout=device_timeout,
-                use_cache=False)
+                use_cache=False, mesh_devices=mesh_devices)
             for i, v in zip(misses, fresh):
                 out[i] = v
             if resolved == "host":
@@ -276,7 +314,8 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
 
         status, value = boxed_call(
             lambda: p256.verify_batch_prehashed(
-                digests, sigs, pubs, pad_block=pad_block),
+                digests, sigs, pubs, pad_block=pad_block,
+                mesh=_verify_mesh(mesh_devices)),
             timeout=device_timeout)  # generous: covers first-call compile
         log = logging.getLogger("upow_tpu.verify")
         if status == "ok":
@@ -348,11 +387,13 @@ class TxVerifier:
     def __init__(self, state: ChainState, is_syncing: bool = False,
                  verify_pad_block: int = 128,
                  verify_device_timeout: float = 240.0,
-                 tx_overlay: Optional[Dict[str, Tx]] = None):
+                 tx_overlay: Optional[Dict[str, Tx]] = None,
+                 verify_mesh_devices: int = 1):
         self.state = state
         self.is_syncing = is_syncing
         self.verify_pad_block = verify_pad_block
         self.verify_device_timeout = verify_device_timeout
+        self.verify_mesh_devices = verify_mesh_devices
         # not-yet-accepted source txs (chain-sync page prefill): input
         # resolution consults these before the chain state, so signature
         # checks for a whole sync page can be collected up front even
@@ -634,7 +675,8 @@ class TxVerifier:
             return False
         return all(await run_sig_checks_async(
             checks, backend=sig_backend, pad_block=self.verify_pad_block,
-            device_timeout=self.verify_device_timeout))
+            device_timeout=self.verify_device_timeout,
+            mesh_devices=self.verify_mesh_devices))
 
     async def verify_pending(self, tx: Tx, sig_backend: str = "auto") -> bool:
         """add-pending intake check (transaction.py:481-482)."""
