@@ -475,3 +475,20 @@ def test_packed_word_unpack_matches_limbs():
     got = np.asarray(p256._words_to_limbs(w))
     want = np.pad(fp.ints_to_limbs(xs), ((0, 0), (0, 3)))
     assert np.array_equal(got, want)
+
+
+def test_point_mul_G_jacobian_matches_generic_ladder():
+    """The fixed-base Jacobian table walk (wallet signing hot loop) must
+    equal the generic affine double-and-add for random and edge scalars,
+    including oversized keys (reduced mod n)."""
+    import random as _random
+
+    from upow_tpu.core import curve
+    from upow_tpu.core.constants import CURVE_N
+
+    rng = _random.Random(0xEC)
+    scalars = [rng.randrange(1, CURVE_N) for _ in range(40)]
+    scalars += [1, 2, 255, 256, 257, 0xFF00, (1 << 248) * 255,
+                CURVE_N - 1, CURVE_N, CURVE_N + 5, (1 << 256) - 1]
+    for k in scalars:
+        assert curve.point_mul_G(k) == curve.point_mul(k % CURVE_N, curve.G), k

@@ -80,12 +80,80 @@ def _g_table() -> list:
     return _G_TABLE
 
 
+def _jac_madd(p1, p2):
+    """Jacobian (X1,Y1,Z1) + affine (x2,y2) mixed addition — the table
+    walk's inner op, no modular inverse (one inverse total at the end
+    instead of one per add; signing is the wallet's per-tx hot loop)."""
+    X1, Y1, Z1 = p1
+    x2, y2 = p2
+    Z1Z1 = Z1 * Z1 % CURVE_P
+    A = (x2 * Z1Z1 - X1) % CURVE_P
+    B = (y2 * Z1 * Z1Z1 - Y1) % CURVE_P
+    if A == 0:
+        if B == 0:
+            return _jac_double(p1)
+        return None  # P + (-P) = infinity
+    AA = A * A % CURVE_P
+    AAA = AA * A % CURVE_P
+    X1AA = X1 * AA % CURVE_P
+    X3 = (B * B - AAA - 2 * X1AA) % CURVE_P
+    Y3 = (B * (X1AA - X3) - Y1 * AAA) % CURVE_P
+    Z3 = Z1 * A % CURVE_P
+    return (X3, Y3, Z3)
+
+
+def _jac_double(p):
+    """Jacobian doubling for a = -3 (P-256)."""
+    X1, Y1, Z1 = p
+    delta = Z1 * Z1 % CURVE_P
+    gamma = Y1 * Y1 % CURVE_P
+    beta = X1 * gamma % CURVE_P
+    alpha = 3 * (X1 - delta) * (X1 + delta) % CURVE_P
+    X3 = (alpha * alpha - 8 * beta) % CURVE_P
+    Z3 = ((Y1 + Z1) * (Y1 + Z1) - gamma - delta) % CURVE_P
+    Y3 = (alpha * (4 * beta - X3) - 8 * gamma * gamma) % CURVE_P
+    return (X3, Y3, Z3)
+
+
 def point_mul_G(k: int) -> Point:
     """k * G via the fixed-base window table (same result as
-    ``point_mul(k, G)``)."""
+    ``point_mul(k, G)``).  Accumulates in Jacobian coordinates — one
+    modular inversion total instead of one per table add."""
     k %= CURVE_N  # table only spans 256 bits; also handles oversized keys
     if k == 0:
         return None
+    k0 = k
+    table = _g_table()
+    acc = None  # Jacobian accumulator
+    i = 0
+    while k:
+        d = k & 0xFF
+        if d:
+            x2, y2 = table[i][d - 1]
+            if acc is None:
+                acc = (x2, y2, 1)
+            else:
+                acc = _jac_madd(acc, (x2, y2))
+                if acc is None:  # pragma: no cover
+                    # Defensive only — PROVABLY unreachable: before the
+                    # window-i add, acc = (k mod 2^(8i))·G and the entry
+                    # is d·2^(8i)·G with both partial values strictly
+                    # inside (0, n), so neither cancellation nor the
+                    # doubling case can occur for any k in [1, n-1].
+                    return _point_mul_G_affine(k0)
+        k >>= 8
+        i += 1
+    if acc is None:
+        return None
+    X, Y, Z = acc
+    z_inv = _inv(Z, CURVE_P)
+    z2 = z_inv * z_inv % CURVE_P
+    return (X * z2 % CURVE_P, Y * z2 * z_inv % CURVE_P)
+
+
+def _point_mul_G_affine(k: int) -> Point:  # pragma: no cover
+    """Affine fallback behind the provably-unreachable guard above
+    (kept as defense in depth for the signing path)."""
     table = _g_table()
     result: Point = None
     i = 0
