@@ -492,3 +492,29 @@ def test_point_mul_G_jacobian_matches_generic_ladder():
                 CURVE_N - 1, CURVE_N, CURVE_N + 5, (1 << 256) - 1]
     for k in scalars:
         assert curve.point_mul_G(k) == curve.point_mul(k % CURVE_N, curve.G), k
+
+
+def test_point_mul_jacobian_matches_affine_ladder():
+    """The Jacobian MSB ladder must equal the affine oracle for random
+    AND adversarial scalars — verify scalars are attacker-influenced, so
+    the mid-ladder identity cases (accumulator hitting ±p) are reachable
+    and must resolve exactly."""
+    import random as _random
+
+    from upow_tpu.core import curve
+    from upow_tpu.core.constants import CURVE_N
+
+    rng = _random.Random(0xAD)
+    n = CURVE_N
+    _, p = curve.keygen(rng=0xABC)
+    scalars = [rng.randrange(1, n) for _ in range(25)]
+    scalars += [1, 2, 3, n - 1, n, n + 1, n + 2,
+                ((n + 1) // 2 << 1) | 1,        # doubling branch
+                ((n - 1) // 2 << 1) | 1,        # cancellation -> infinity
+                ((((n - 1) // 2 << 1) | 1) << 3) | 5,  # restart after it
+                (n - 1) << 4 | 0xF]
+    for k in scalars:
+        assert curve.point_mul(k, p) == \
+            curve._point_mul_affine_ladder(k, p), k
+    assert curve.point_mul(5, None) is None
+    assert curve.point_mul(0, p) is None
