@@ -102,27 +102,13 @@ def config2_fractional(seconds: float, backend: str):
 def config3_batch_verify(seconds: float):
     """8k-signature block verify (the reference's per-input fastecdsa
     loop, transaction_input.py:100-109, measures ~2-6k/s/core)."""
-    from upow_tpu.core import curve
+    from upow_tpu.benchutil import python_verify_rate, verify_fixture
     from upow_tpu.crypto import p256
 
-    msgs, sigs, pubs = [], [], []
-    for i in range(256):
-        d, pub = curve.keygen(rng=7000 + i)
-        m = i.to_bytes(4, "big") * 8
-        sigs.append(curve.sign(m, d))
-        msgs.append(m)
-        pubs.append(pub)
-    k = 8192 // 256
-    msgs, sigs, pubs = msgs * k, sigs * k, pubs * k
-    digests = [hashlib.sha256(m).digest() for m in msgs]
+    digests, sigs, pubs, msgs = verify_fixture(8192, n_unique=256)
 
     # host baseline: pure-python ECDSA verify, short sample
-    t0 = time.perf_counter()
-    n_base = 0
-    while time.perf_counter() - t0 < 1.0:
-        curve.verify(sigs[n_base % 256], msgs[n_base % 256], pubs[n_base % 256])
-        n_base += 1
-    base_rate = n_base / (time.perf_counter() - t0)
+    base_rate = python_verify_rate(msgs, sigs, pubs)
 
     v = p256.verify_batch_prehashed(digests, sigs, pubs, pad_block=8192)
     assert all(v)

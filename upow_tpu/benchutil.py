@@ -81,6 +81,52 @@ def python_loop_mhs(prefix: bytes, seconds: float = 1.0) -> float:
     return n / (time.perf_counter() - t0) / 1e6
 
 
+def verify_fixture(n_lanes: int, n_unique: int = 128, rng_base: int = 7000):
+    """Shared signature-verify bench fixture (bench.py and bench_suite
+    config 3): ``n_unique`` distinct keypairs/messages tiled to
+    ``n_lanes`` lanes.  Returns (digests, sigs, pubs, msgs)."""
+    from .core import curve
+
+    msgs, sigs, pubs = [], [], []
+    for i in range(n_unique):
+        d, pub = curve.keygen(rng=rng_base + i)
+        m = i.to_bytes(4, "big") * 8
+        sigs.append(curve.sign(m, d))
+        msgs.append(m)
+        pubs.append(pub)
+    k = n_lanes // n_unique
+    msgs, sigs, pubs = msgs * k, sigs * k, pubs * k
+    digests = [hashlib.sha256(m).digest() for m in msgs]
+    return digests, sigs, pubs, msgs
+
+
+def python_verify_rate(msgs, sigs, pubs, seconds: float = 1.0) -> float:
+    """Pure-python ECDSA verify rate on this host (the bench baseline
+    convention for the reference's per-input fastecdsa loop)."""
+    from .core import curve
+
+    n_u = len(msgs)
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < seconds:
+        curve.verify(sigs[n % n_u], msgs[n % n_u], pubs[n % n_u])
+        n += 1
+    return n / (time.perf_counter() - t0)
+
+
+def timed_reps(fn, seconds: float, max_reps: Optional[int] = None):
+    """Repeat ``fn`` until the deadline (or ``max_reps``); returns
+    (reps, elapsed).  The shared timed-loop plumbing for synchronous
+    bench measurements."""
+    t0 = time.perf_counter()
+    reps = 0
+    while time.perf_counter() - t0 < seconds and (
+            max_reps is None or reps < max_reps):
+        fn()
+        reps += 1
+    return reps, time.perf_counter() - t0
+
+
 def pipelined_loop(dispatch, finalize, seconds: float, depth: int = 2):
     """Keep up to ``depth`` async dispatches in flight until the deadline,
     then drain.  Returns (completed_rounds, elapsed) — elapsed includes
