@@ -8,6 +8,16 @@ feature set logs ``cpu_aot_loader`` feature-mismatch errors and can run
 miscompiled code (observed: an execution that never completes).  Keying
 the directory by a host fingerprint keeps reruns on the same machine
 instant while making foreign entries invisible.
+
+Known cosmetic residue: this XLA build's AOT loader compares the
+compile-time LLVM feature string — which includes derived *tuning*
+preferences (``+prefer-no-gather``/``+prefer-no-scatter``) — against a
+host probe that never reports tuning prefs, so reloading an entry
+compiled BY THIS SAME HOST still logs a two-feature mismatch warning
+(verified 2026-08-01: cold-compile then warm-reload in one session,
+same dir, warnings present, results correct).  Genuine cross-host
+divergence is what the fingerprint prevents; the warning text alone is
+not evidence of it.
 """
 
 from __future__ import annotations
@@ -17,22 +27,61 @@ import os
 import platform
 
 
+_FP_CACHE = None
+
+
+def _gcc_native_march() -> str:
+    """GCC's CPUID-based microarch detection (``-march=native``
+    expansion).  Virtualized /proc/cpuinfo is often generic and
+    identical across different physical hosts, while the LLVM tuning
+    features XLA:CPU AOT code is specialised to (e.g.
+    ``prefer-no-gather``) come from raw CPUID — two hosts with the same
+    cpuinfo can still produce incompatible AOT entries (observed: a VM
+    migration flagged feature mismatches under an unchanged cpuinfo
+    fingerprint).  GCC reads the same CPUID, so its expansion
+    distinguishes those hosts."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["gcc", "-march=native", "-E", "-v", "-"],
+            stdin=subprocess.DEVNULL, capture_output=True, text=True,
+            timeout=15)
+        for line in (out.stderr + out.stdout).splitlines():
+            if "-march=" in line:
+                return line[line.index("-march="):].strip()
+    except Exception:
+        pass
+    return "gcc-unavailable"
+
+
 def host_fingerprint() -> str:
-    """Stable per-machine tag: arch + CPU flag set (+ model name)."""
-    bits = [platform.machine()]
+    """Stable per-machine tag: arch + CPU flags + microarch identity
+    (family/model/stepping/microcode) + GCC's CPUID-detected feature
+    expansion.  'fpv2' orphans pre-round-4 dirs whose entries may have
+    been produced by a cpuinfo-identical but tuning-different host."""
+    global _FP_CACHE
+    if _FP_CACHE is not None:
+        return _FP_CACHE
+    bits = ["fpv2", platform.machine()]
     try:
         seen = set()
         with open("/proc/cpuinfo") as f:
             for line in f:
                 key = line.split(":", 1)[0].strip()
                 # one of each: the FLAGS are what the AOT cache entries
-                # are specialised to; model name disambiguates further
-                if key in ("flags", "Features", "model name") and key not in seen:
+                # are specialised to; family/model/stepping/microcode
+                # pin the microarch even when the model name is generic
+                if key in ("flags", "Features", "model name", "vendor_id",
+                           "cpu family", "model", "stepping",
+                           "microcode") and key not in seen:
                     seen.add(key)
                     bits.append(line.strip())
     except OSError:
         bits.append(platform.processor() or "unknown")
-    return hashlib.sha256("|".join(bits).encode()).hexdigest()[:12]
+    bits.append(_gcc_native_march())
+    _FP_CACHE = hashlib.sha256("|".join(bits).encode()).hexdigest()[:12]
+    return _FP_CACHE
 
 
 def evict_host_dir(cache_root: str) -> None:
