@@ -503,6 +503,172 @@ def test_pg_concurrent_writer_isolated_from_atomic_rollback():
     run(main())
 
 
+def test_mock_pg_write_side_type_fidelity():
+    """VERDICT r3 ask #3 (no server in this image — pip/apt attempted
+    2026-08-01, no egress): emulate PostgreSQL's write-side column
+    semantics in the mock so our SQL discipline is tested against them.
+
+    NUMERIC(p,s) quantizes half-away-from-zero and raises
+    numeric_value_out_of_range on integer-digit overflow; TIMESTAMP(0)
+    rounds fractional seconds; integrity errors surface as the shared
+    driver-neutral taxonomy (same classes AsyncpgDriver maps asyncpg's
+    SQLSTATEs onto)."""
+    import datetime
+
+    from upow_tpu.state.pgdriver import (IntegrityViolation,
+                                         NumericValueOutOfRange,
+                                         UniqueViolation)
+
+    drv = MockPgDriver()
+    now = datetime.datetime(2026, 8, 1, 12, 0, 0)
+    pending_ins = ("INSERT INTO pending_transactions (tx_hash, tx_hex,"
+                   " inputs_addresses, fees, propagation_time)"
+                   " VALUES ($1, $2, $3, $4, $5)")
+    # fees NUMERIC(14,6): 8-dp value quantizes at 6 dp, half up
+    drv.execute(pending_ins, ("aa" * 32, "00", [], Decimal("0.00000050"), now))
+    row = drv.fetch("SELECT fees FROM pending_transactions")[0]
+    assert row["fees"] == Decimal("0.000001")
+    # integer-digit overflow (14-6 = 8 digits max) raises like the server
+    with pytest.raises(NumericValueOutOfRange):
+        drv.execute(pending_ins,
+                    ("bb" * 32, "00", [], Decimal("123456789"), now))
+    # PK violation maps to the shared taxonomy (subclass of integrity)
+    with pytest.raises(UniqueViolation) as ei:
+        drv.execute(pending_ins, ("aa" * 32, "00", [], Decimal("0"), now))
+    assert isinstance(ei.value, IntegrityViolation)
+    assert ei.value.sqlstate == "23505"
+    # TIMESTAMP(0): fractional seconds round to nearest
+    ts = datetime.datetime(2026, 8, 1, 12, 0, 0, 700_000)
+    drv.execute(
+        "INSERT INTO blocks (id, hash, content, address, random,"
+        " difficulty, reward, timestamp)"
+        " VALUES ($1, $2, $3, $4, $5, $6, $7, $8)",
+        (1, "cc" * 32, "", "addr", 0, Decimal("1.0"), Decimal("1"), ts))
+    got = drv.fetch("SELECT timestamp FROM blocks")[0]["timestamp"]
+    assert got == datetime.datetime(2026, 8, 1, 12, 0, 1)
+    drv.close()
+
+
+def test_mock_executemany_is_atomic_like_asyncpg():
+    """ADVICE r3: asyncpg's executemany is implicitly transactional and
+    pg.py relies on that; the mock must not be weaker — a failing row
+    rolls back the rows before it (unless an outer txn owns atomicity)."""
+    drv = MockPgDriver()
+    drv.execute("CREATE TABLE t (k TEXT PRIMARY KEY)")
+    with pytest.raises(Exception):
+        drv.executemany("INSERT INTO t (k) VALUES ($1)",
+                        [("a",), ("b",), ("a",)])  # third row: PK clash
+    assert drv.fetch("SELECT k FROM t") == []  # nothing survived
+
+    # inside an explicit transaction the outer owner decides
+    drv.begin()
+    with pytest.raises(Exception):
+        drv.executemany("INSERT INTO t (k) VALUES ($1)", [("c",), ("c",)])
+    drv.commit()
+    assert [r["k"] for r in drv.fetch("SELECT k FROM t")] == ["c"]
+    drv.close()
+
+
+def test_pg_get_blocks_single_query_page(make_state):
+    """get_blocks serves a sync page with embedded transactions in two
+    driver round trips (blocks + one ANY() transactions fetch)."""
+
+    async def main():
+        state = make_state()
+        manager = BlockManager(state, sig_backend="host")
+        builder = WalletBuilder(state)
+        actors = make_actors()
+        d_g, a_g = actors["genesis"]
+        _, a_o = actors["outsider"]
+        for _ in range(3):
+            await mine_block(manager, state, a_g)
+        tx = await builder.create_transaction(d_g, a_o, "2")
+        await push(state, tx)
+        await mine_block(manager, state, a_g, include_pending=True)
+
+        page = await state.get_blocks(2, 10)
+        assert [p["block"]["id"] for p in page] == [2, 3, 4]
+        # block 4 embeds coinbase + the send, matching direct lookup
+        assert len(page[-1]["transactions"]) == 2
+        assert tx.hex() in page[-1]["transactions"]
+        assert all(isinstance(p["transactions"], list) for p in page)
+        assert await state.get_blocks(99, 10) == []
+
+    run(main())
+
+
+def test_pg_reorg_snapshot_shares_writer_lock_with_deletes():
+    """ADVICE r3 (medium): remove_blocks used to take its doomed-tx
+    snapshot BEFORE acquiring the writer lock; since every pg driver
+    call yields, a concurrent accept could commit a block >=
+    from_block_id between snapshot and deletes — the delete cascade then
+    dropped that block's transactions without restoring the UTXOs they
+    spent.  Deterministic schedule: gate the reorg task's first
+    writer-lock acquisition, land a spend-carrying block 5 in the gap,
+    then let the reorg run.  Fixed code snapshots under the lock, sees
+    block 5, and restores its spent outputs."""
+    import contextlib
+
+    async def main():
+        state = PgChainState(driver=MockPgDriver())
+        manager = BlockManager(state, sig_backend="host")
+        builder = WalletBuilder(state)
+        actors = make_actors()
+        d_g, a_g = actors["genesis"]
+        _, a_o = actors["outsider"]
+        for _ in range(3):
+            await mine_block(manager, state, a_g)
+        fp3 = await state.get_full_state_hash()
+        bal3 = await state.get_address_balance(a_g)
+        # block 4: the block-5 spend's greedy selection is oldest-first,
+        # so it consumes block-1/2 coinbase outputs — source txs OUTSIDE
+        # the doomed set (the restore path the race corrupts)
+        await mine_block(manager, state, a_g)
+
+        release = asyncio.Event()
+        gated = []
+        orig_writer = state._writer
+        reorg_task = []
+
+        def gating_writer():
+            if (asyncio.current_task() is (reorg_task[0] if reorg_task
+                                           else None) and not gated):
+                gated.append(True)
+
+                @contextlib.asynccontextmanager
+                async def g():
+                    await release.wait()
+                    async with orig_writer():
+                        yield
+
+                return g()
+            return orig_writer()
+
+        state._writer = gating_writer
+        reorg_task.append(asyncio.ensure_future(state.remove_blocks(4)))
+        for _ in range(2000):
+            if gated:
+                break
+            await asyncio.sleep(0)
+        assert gated, "reorg task never reached its writer-lock acquire"
+
+        # the concurrent accept: block 5 spends a_g's early coinbase
+        tx = await builder.create_transaction(d_g, a_o, "4")
+        await push(state, tx)
+        await mine_block(manager, state, a_g, include_pending=True)
+
+        release.set()
+        await reorg_task[0]
+        state._writer = orig_writer
+
+        assert (await state.get_last_block())["id"] == 3
+        assert await state.get_full_state_hash() == fp3
+        assert await state.get_address_balance(a_g) == bal3
+        state.close()
+
+    run(main())
+
+
 def test_pg_concurrent_churn():
     """Randomized concurrent churn over the async pg backend: a miner
     accepting blocks, a mempool intake task, a propagation updater, and

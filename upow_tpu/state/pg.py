@@ -107,9 +107,14 @@ PG_SCHEMA = [
 
 
 def _coins(units: int) -> Decimal:
-    """int smallest-units -> NUMERIC(14,6) coin value (quantized the way
-    the column would)."""
-    return (Decimal(units) / SMALLEST).quantize(_COIN_Q)
+    """int smallest-units -> NUMERIC(14,6) coin value, quantized the way
+    the column would: PostgreSQL numeric rounds half AWAY FROM ZERO
+    (Decimal's default half-even would store 0.0000005 coins as 0 where
+    the reference's server stores 0.000001)."""
+    from decimal import ROUND_HALF_UP
+
+    return (Decimal(units) / SMALLEST).quantize(_COIN_Q,
+                                                rounding=ROUND_HALF_UP)
 
 
 def _units(coins: Optional[Decimal]) -> int:
@@ -351,33 +356,48 @@ class PgChainState(StateViews):
         return (rows[0]["m"] or 0) + 1
 
     async def get_blocks(self, offset: int, limit: int) -> List[dict]:
-        """Blocks with embedded full transactions (database.py:380-437)."""
+        """Blocks with embedded full transactions (database.py:380-437).
+
+        One transactions query for the whole page (grouped host-side) —
+        a 1000-block sync page is 2 round trips on the network-attached
+        driver, not 1001."""
         rows = await self.drv.afetch(
             "SELECT * FROM blocks WHERE id >= $1 ORDER BY id LIMIT $2",
             (offset, limit))
+        by_hash: dict = {r["hash"]: [] for r in rows}
+        if rows:
+            txs = await self.drv.afetch(
+                "SELECT block_hash, tx_hex FROM transactions"
+                " WHERE block_hash = ANY($1)", (list(by_hash),))
+            for t in txs:
+                by_hash[t["block_hash"]].append(t["tx_hex"])
         out = []
         for r in rows:
-            txs = await self.drv.afetch(
-                "SELECT tx_hex FROM transactions WHERE block_hash = $1",
-                (r["hash"],))
             block = self._block_dict(r)
             block["difficulty"] = float(block["difficulty"])
             block["reward"] = str(block["reward"])
             out.append({
                 "block": block,
-                "transactions": [t["tx_hex"] for t in txs],
+                "transactions": by_hash[r["hash"]],
             })
         return out
 
     async def remove_blocks(self, from_block_id: int) -> None:
         """Reorg rollback (database.py:146-169), same dependent-tx filter
         as the sqlite backend."""
-        rows = await self.drv.afetch(
-            "SELECT t.tx_hex FROM transactions t JOIN blocks b"
-            " ON t.block_hash = b.hash WHERE b.id >= $1", (from_block_id,))
-        txs = [tx_from_hex(r["tx_hex"], check_signatures=False) for r in rows]
-        created = [tx.hash() for tx in txs]
         async with self._txn():
+            # the doomed-tx snapshot must share the writer-lock scope
+            # with the deletes: every driver call yields, so a snapshot
+            # taken outside could miss a block accepted concurrently at
+            # >= from_block_id — DELETE FROM blocks would then cascade
+            # its transactions without restoring their spent UTXOs
+            rows = await self.drv.afetch(
+                "SELECT t.tx_hex FROM transactions t JOIN blocks b"
+                " ON t.block_hash = b.hash WHERE b.id >= $1",
+                (from_block_id,))
+            txs = [tx_from_hex(r["tx_hex"], check_signatures=False)
+                   for r in rows]
+            created = [tx.hash() for tx in txs]
             for table in ("unspent_outputs",) + _GOV_TABLES:
                 await self.drv.aexecutemany(
                     f"DELETE FROM {table} WHERE tx_hash = $1",

@@ -27,11 +27,66 @@ from __future__ import annotations
 
 import asyncio
 import datetime
+import decimal as decimal_mod
 import json
 import re
 import threading
 from decimal import Decimal
+from functools import lru_cache
 from typing import Any, Iterable, List, Optional, Sequence
+
+
+class PgDriverError(Exception):
+    """Driver-neutral error taxonomy.  AsyncpgDriver maps asyncpg's
+    SQLSTATE-classed exceptions onto these; MockPgDriver maps sqlite's —
+    so storage-layer code (and tests) can catch ONE set of classes with
+    both drivers.  ``sqlstate`` carries the PostgreSQL class code."""
+
+    sqlstate: Optional[str] = None
+
+
+class IntegrityViolation(PgDriverError):
+    sqlstate = "23000"
+
+
+class UniqueViolation(IntegrityViolation):
+    sqlstate = "23505"
+
+
+class ForeignKeyViolation(IntegrityViolation):
+    sqlstate = "23503"
+
+
+class NumericValueOutOfRange(PgDriverError):
+    sqlstate = "22003"
+
+
+def _map_asyncpg_error(e):
+    """asyncpg.PostgresError -> the shim taxonomy (by SQLSTATE)."""
+    code = getattr(e, "sqlstate", None) or ""
+    if code == "23505":
+        cls = UniqueViolation
+    elif code == "23503":
+        cls = ForeignKeyViolation
+    elif code.startswith("23"):
+        cls = IntegrityViolation
+    elif code == "22003":
+        cls = NumericValueOutOfRange
+    else:
+        return e  # pass through: connection/protocol errors keep their type
+    out = cls(str(e))
+    out.sqlstate = code
+    return out
+
+
+def _map_sqlite_error(e):
+    """sqlite3.IntegrityError -> the shim taxonomy (by message)."""
+    msg = str(e)
+    if "UNIQUE constraint" in msg:
+        return UniqueViolation(msg)
+    if "FOREIGN KEY constraint" in msg:
+        return ForeignKeyViolation(msg)
+    return IntegrityViolation(msg)
 
 
 def _utc(dt_or_epoch) -> datetime.datetime:
@@ -177,7 +232,16 @@ class AsyncpgDriver:
             self._oplock = asyncio.Lock()
         async with self._oplock:
             await self._ensure_conn()
-            return await op()
+            try:
+                return await op()
+            except Exception as e:
+                import asyncpg
+
+                if isinstance(e, asyncpg.PostgresError):
+                    mapped = _map_asyncpg_error(e)
+                    if mapped is not e:
+                        raise mapped from e
+                raise
 
     # -- sync facade (CLI tools, tests) --
 
@@ -298,6 +362,27 @@ CREATE TABLE IF NOT EXISTS {_t} (
 
 _PLACEHOLDER = re.compile(r"\$(\d+)")
 _ANY_CLAUSE = re.compile(r"\$(\d+)\s*=\s*ANY\s*\(\s*(\w+)\s*\)")
+_ANY_PARAM = re.compile(r"(\w+)\s*=\s*ANY\s*\(\s*\$(\d+)\s*\)")
+_INSERT_COLS = re.compile(
+    r"INSERT\s+INTO\s+\w+\s*\(([^)]*)\)\s*VALUES\s*\(([^)]*)\)", re.I)
+
+# reference schema.sql column types the mock must emulate on WRITE:
+# NUMERIC(p, s) quantizes (PostgreSQL rounds half away from zero) and
+# raises numeric_value_out_of_range when integer digits exceed p - s;
+# TIMESTAMP(0) rounds fractional seconds to the nearest second
+_NUMERIC_SPEC = {"difficulty": (3, 1), "reward": (14, 6), "fees": (14, 6)}
+_TS0_COLS = {"timestamp", "propagation_time"}
+
+
+def _quantize_numeric(value: Decimal, col: str) -> Decimal:
+    precision, scale = _NUMERIC_SPEC[col]
+    q = value.quantize(Decimal(1).scaleb(-scale),
+                       rounding=decimal_mod.ROUND_HALF_UP)
+    if q.adjusted() + 1 > precision - scale:
+        raise NumericValueOutOfRange(
+            f"numeric field overflow: {col} NUMERIC({precision},{scale}) "
+            f"cannot hold {value}")
+    return q
 
 
 class MockPgDriver:
@@ -356,24 +441,79 @@ class MockPgDriver:
         sql = _ANY_CLAUSE.sub(
             r"EXISTS (SELECT 1 FROM json_each(\2) WHERE"
             r" json_each.value = :p\1)", sql)
+        # `col = ANY($k)`: asyncpg list param -> IN over the JSON array
+        # the list converts to
+        sql = _ANY_PARAM.sub(
+            r"\1 IN (SELECT value FROM json_each(:p\2))", sql)
         return _PLACEHOLDER.sub(r":p\1", sql)
 
-    def _params(self, args: Sequence[Any]) -> dict:
-        return {f"p{i + 1}": self._convert_in(v) for i, v in enumerate(args)}
+    @staticmethod
+    @lru_cache(maxsize=256)
+    def _insert_param_cols(pg_sql: str) -> tuple:
+        """For INSERT statements: map 1-based param index -> column name
+        (None where the value isn't a bare placeholder), so write-side
+        type semantics (NUMERIC quantization, TIMESTAMP(0) rounding)
+        apply to the right params."""
+        m = _INSERT_COLS.search(pg_sql)
+        if not m:
+            return ()
+        cols = [c.strip().strip('"') for c in m.group(1).split(",")]
+        out = {}
+        for col, val in zip(cols, m.group(2).split(",")):
+            pm = re.fullmatch(r"\s*\$(\d+)\s*", val)
+            if pm:
+                out[int(pm.group(1))] = col
+        return tuple(sorted(out.items()))
+
+    def _params(self, args: Sequence[Any], pg_sql: str = "") -> dict:
+        by_idx = dict(self._insert_param_cols(pg_sql)) if pg_sql else {}
+        out = {}
+        for i, v in enumerate(args):
+            col = by_idx.get(i + 1)
+            if isinstance(v, Decimal) and col in _NUMERIC_SPEC:
+                v = _quantize_numeric(v, col)
+            elif isinstance(v, datetime.datetime) and col in _TS0_COLS \
+                    and v.microsecond:
+                v = v.replace(microsecond=0) + datetime.timedelta(
+                    seconds=1 if v.microsecond >= 500_000 else 0)
+            out[f"p{i + 1}"] = self._convert_in(v)
+        return out
 
     # -- facade --
 
+    def _run(self, sqlite_sql: str, params: dict):
+        import sqlite3
+
+        try:
+            return self.db.execute(sqlite_sql, params)
+        except sqlite3.IntegrityError as e:
+            raise _map_sqlite_error(e) from e
+
     def fetch(self, sql: str, args: Sequence[Any] = ()) -> List[dict]:
-        rows = self.db.execute(self._translate(sql), self._params(args)).fetchall()
+        rows = self._run(self._translate(sql), self._params(args, sql)).fetchall()
         return [self._convert_out(r) for r in rows]
 
     def execute(self, sql: str, args: Sequence[Any] = ()) -> None:
-        self.db.execute(self._translate(sql), self._params(args))
+        self._run(self._translate(sql), self._params(args, sql))
 
     def executemany(self, sql: str, rows: Iterable[Sequence[Any]]) -> None:
-        sql = self._translate(sql)
-        for args in rows:
-            self.db.execute(sql, self._params(args))
+        """Row loop under an implicit transaction (when none is open) —
+        asyncpg's executemany is atomic, and the backend relies on that
+        (pg.py add_transactions); the mock must not be weaker."""
+        sqlite_sql = self._translate(sql)
+        own_txn = not self.db.in_transaction
+        if own_txn:
+            self.db.execute("BEGIN")
+        try:
+            for args in rows:
+                self._run(sqlite_sql, self._params(args, sql))
+        except BaseException:
+            if own_txn:
+                self.db.execute("ROLLBACK")
+            raise
+        else:
+            if own_txn:
+                self.db.execute("COMMIT")
 
     def begin(self) -> None:
         self.db.execute("BEGIN")
