@@ -510,6 +510,111 @@ def test_sync_with_device_txid_batch(tmp_path, keys, monkeypatch):
     run_cluster(tmp_path, scenario)
 
 
+def test_sync_survives_faulty_device_txid(tmp_path, keys, monkeypatch):
+    """ADVICE r3: a corrupted device digest that slips past the
+    integrity sample seeds a wrong tx hash; the recomputed merkle then
+    mismatches the header and the page is rejected — sync must fall
+    back to host hashing for the retry instead of wedging on the faulty
+    device (app.create_blocks merkle-mismatch retry)."""
+
+    async def scenario(cluster):
+        node_a, client_a = await cluster.add_node("a")
+        node_b, client_b = await cluster.add_node("b")
+        node_b.config.device.txid_backend = "device"
+        node_b.config.device.txid_min_batch = 2
+        import upow_tpu.crypto.sha256 as sha_mod
+
+        await mine_via_api(client_a, keys["addr"])
+        builder = WalletBuilder(node_a.state)
+        tx = await builder.create_transaction(keys["d"], keys["addr2"], "2")
+        await node_a.state.add_pending_transaction(tx)
+        await mine_via_api(client_a, keys["addr"])
+        target_payload = bytes.fromhex(tx.hex())
+
+        calls = []
+        real = sha_mod.txid_batch
+
+        def faulty_device(payloads, **kw):
+            out = real(payloads, backend="host")  # digests, right shapes
+            calls.append(len(payloads))
+            # one persistent bad lane: the send tx's digest is wrong on
+            # EVERY device batch (the integrity sample can miss it; the
+            # merkle check cannot)
+            return [("0" * 64 if p == target_payload else d)
+                    for p, d in zip(payloads, out)]
+
+        monkeypatch.setattr(sha_mod, "txid_batch", faulty_device)
+        res = await (await client_b.get(
+            "/sync_blockchain", params={"node_url": cluster.url(0)})).json()
+        assert res["ok"], res
+        assert calls, "device txid path never ran"
+        assert (await node_b.state.get_address_balance(keys["addr2"])) \
+            == 2 * 10**8
+        assert (await node_a.state.get_unspent_outputs_hash()
+                == await node_b.state.get_unspent_outputs_hash())
+        # no poisoned memo reached storage
+        import hashlib
+
+        for h in await node_b.state.get_block_transaction_hashes(
+                (await node_b.state.get_last_block())["hash"]):
+            tx_b = await node_b.state.get_transaction(h)
+            # the STORED key equals the independently recomputed txid
+            assert h == hashlib.sha256(
+                bytes.fromhex(tx_b.hex())).hexdigest()
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_sync_faulty_device_txid_content_absent_page(tmp_path, keys,
+                                                     monkeypatch):
+    """Same fault as above but the page entries carry NO 'content': the
+    node rebuilds each header itself.  The rebuilt header must embed the
+    raw-bytes merkle root, not the memo-derived one — otherwise
+    check_block compares the corrupt device seed with itself and the
+    block commits keyed under a wrong txid (review r4 finding)."""
+
+    async def scenario(cluster):
+        node_a, client_a = await cluster.add_node("a")
+        node_b, client_b = await cluster.add_node("b")
+        node_b.config.device.txid_backend = "device"
+        node_b.config.device.txid_min_batch = 2
+        import upow_tpu.crypto.sha256 as sha_mod
+
+        await mine_via_api(client_a, keys["addr"])
+        builder = WalletBuilder(node_a.state)
+        tx = await builder.create_transaction(keys["d"], keys["addr2"], "2")
+        await node_a.state.add_pending_transaction(tx)
+        await mine_via_api(client_a, keys["addr"])
+        target_payload = bytes.fromhex(tx.hex())
+
+        real = sha_mod.txid_batch
+
+        def faulty_device(payloads, **kw):
+            out = real(payloads, backend="host")
+            return [("0" * 64 if p == target_payload else d)
+                    for p, d in zip(payloads, out)]
+
+        monkeypatch.setattr(sha_mod, "txid_batch", faulty_device)
+        page = await node_a.state.get_blocks(1, 500)
+        for entry in page:
+            entry["block"] = dict(entry["block"])
+            entry["block"].pop("content", None)
+        errors = []
+        ok = await node_b.create_blocks(page, errors=errors)
+        assert ok, errors
+        assert (await node_a.state.get_unspent_outputs_hash()
+                == await node_b.state.get_unspent_outputs_hash())
+        import hashlib
+
+        for h in await node_b.state.get_block_transaction_hashes(
+                (await node_b.state.get_last_block())["hash"]):
+            tx_b = await node_b.state.get_transaction(h)
+            assert h == hashlib.sha256(
+                bytes.fromhex(tx_b.hex())).hexdigest()
+
+    run_cluster(tmp_path, scenario)
+
+
 def test_fork_reorg_convergence(tmp_path, keys):
     """Partition: A and B mine divergent chains; B (shorter) syncs from A
     and reorgs onto A's chain (main.py:167-185's common-ancestor walk)."""

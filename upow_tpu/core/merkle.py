@@ -19,11 +19,25 @@ def _raw(tx: TxLike) -> bytes:
 
 
 def merkle_root(transactions: Iterable[TxLike]) -> str:
-    """Sorted-by-raw-bytes flat hash (manager.py:365-378)."""
-    acc = b""
-    for raw in sorted(_raw(tx) for tx in transactions):
-        acc += hashlib.sha256(raw).digest()
-    return hashlib.sha256(acc).hexdigest()
+    """Sorted-by-raw-bytes flat hash (manager.py:365-378).
+
+    Each leaf is the txid (sha256 of the raw tx), so for tx OBJECTS the
+    memoized ``tx.hash()`` is used instead of re-hashing: identical by
+    construction (hash() digests the same re-serialized bytes ``_raw``
+    yields), it halves host hashing on the sync path, and — critically —
+    it makes the header comparison in check_block validate
+    device-batched txid seeds against the honest peer's root.  A
+    corrupted device digest that slips past the integrity sample then
+    surfaces as a merkle mismatch (page rejected, host-hash retry)
+    instead of silently keying storage with a wrong txid."""
+    pairs = []
+    for tx in transactions:
+        raw = _raw(tx)
+        digest = (hashlib.sha256(raw).digest() if isinstance(tx, str)
+                  else bytes.fromhex(tx.hash()))
+        pairs.append((raw, digest))
+    pairs.sort(key=lambda p: p[0])
+    return hashlib.sha256(b"".join(d for _, d in pairs)).hexdigest()
 
 
 def merkle_root_ordered(transactions: Iterable[TxLike]) -> str:

@@ -400,6 +400,7 @@ def _sha256_blocks_jnp(words, n_blocks: int):
 
 
 _TXID_AUTO_CHOICE = None  # resolved once per process, by measurement
+_TXID_SAMPLE_SALT = 0  # per-call integrity-sample roam counter
 
 
 def txid_batch(payloads: Sequence[bytes], backend: str = "auto",
@@ -418,8 +419,12 @@ def txid_batch(payloads: Sequence[bytes], backend: str = "auto",
                 either way.
 
     Device digests feed consensus (txids), so a host-side integrity
-    sample (first/middle/last payload) guards every device batch; any
-    mismatch falls back to hashlib for the whole batch.
+    sample (8 indices, roaming per call) guards every device batch; any
+    mismatch falls back to hashlib for the whole batch.  The sample is
+    probabilistic — the deterministic backstop is merkle_root's use of
+    the seeded memos as leaves, which surfaces any corrupt seed as a
+    header mismatch (and app.create_blocks then retries the page with
+    host hashing).
     """
     import hashlib as _hl
 
@@ -447,7 +452,21 @@ def txid_batch(payloads: Sequence[bytes], backend: str = "auto",
             "device txid batch failed (%s); host fallback", e)
         return host(payloads)
     out = [d.hex() for d in digests]
-    for i in {0, len(out) // 2, len(out) - 1}:
+    # sample indices randomized per batch: seeded from the payloads plus
+    # a per-call counter, so a RETRY of the same page samples different
+    # lanes — fixed first/middle/last (or a payload-only seed) would let
+    # a persistent fault in any unsampled lane seed the same wrong txid
+    # every retry, wedging sync until the device recovers
+    import random as _random
+
+    global _TXID_SAMPLE_SALT
+    _TXID_SAMPLE_SALT += 1
+    seed = int.from_bytes(
+        _hl.sha256(payloads[0] + payloads[-1] +
+                   len(payloads).to_bytes(4, "big") +
+                   _TXID_SAMPLE_SALT.to_bytes(8, "big")).digest()[:8], "big")
+    n_samples = min(len(out), 8)
+    for i in _random.Random(seed).sample(range(len(out)), n_samples):
         if _hl.sha256(payloads[i]).hexdigest() != out[i]:
             import logging
 

@@ -1043,7 +1043,8 @@ class Node:
             await iface.close()
 
     async def create_blocks(self, blocks: list,
-                            errors: Optional[list] = None) -> bool:
+                            errors: Optional[list] = None,
+                            _allow_device_txids: bool = True) -> bool:
         """Batch ingest for sync (main.py:97-150): recompute the merkle,
         rebuild content when absent, accept via the sync path that trusts
         the embedded coinbase.
@@ -1061,10 +1062,13 @@ class Node:
         # batched txids for the whole page (SURVEY §2.2): one device (or
         # hashlib) batch seeds every tx's hash memo instead of a
         # per-instance sha256 on first .hash() — guarded below by a
-        # round-trip identity check before any seed is trusted
+        # round-trip identity check (payload == what hash() would
+        # digest), by the per-batch roaming integrity sample inside
+        # txid_batch, and deterministically by check_block's merkle
+        # comparison, whose leaves ARE the seeded memos (core/merkle.py)
         txid_prefill: dict = {}
         dev_cfg = self.config.device
-        if dev_cfg.txid_backend != "host":
+        if dev_cfg.txid_backend != "host" and _allow_device_txids:
             try:
                 all_hex = [t for b in blocks
                            for t in b.get("transactions", ())]
@@ -1127,8 +1131,17 @@ class Node:
         try:
             for block, txs, coinbase in parsed:
                 block["merkle_tree"] = merkle_root(txs)
-                content = block.get("content") or block_to_bytes(
-                    last_hash, block).hex()
+                content = block.get("content")
+                if not content:
+                    # the rebuilt header must NOT embed the memo-derived
+                    # root: check_block compares the header root against
+                    # merkle_root's memo leaves, so embedding the memo
+                    # root would compare a corrupt device seed with
+                    # itself — hash the raw hexes (host) for the header
+                    # and the backstop stays deterministic
+                    block["merkle_tree"] = merkle_root(
+                        [tx.hex() for tx in txs])
+                    content = block_to_bytes(last_hash, block).hex()
                 if int(block["id"]) != i:
                     errors.append(f"unexpected block id {block['id']} != {i}")
                     return False
@@ -1137,6 +1150,30 @@ class Node:
                     return False
                 if not await self.manager.create_block_syncing(
                         content, txs, coinbase, errors=errors):
+                    if (txid_prefill and
+                            any("merkle" in e for e in errors[-2:])):
+                        # a wrong device-seeded txid surfaces here as a
+                        # merkle mismatch; the integrity sample can miss
+                        # a faulty lane, and retrying the page through
+                        # the same device would wedge catch-up for as
+                        # long as the fault lasts — redo the remaining
+                        # blocks with host hashing (fresh parse, no
+                        # seeds) before giving up
+                        log.warning(
+                            "sync accept hit a merkle mismatch with "
+                            "device-seeded txids at block %d; retrying "
+                            "the page with host hashing", i)
+                        self.manager.page_sig_verdicts = None
+                        remaining = [
+                            b for b in blocks
+                            if int(b["block"]["id"]) >= i]
+                        errors.append(
+                            f"retrying {len(remaining)} blocks with "
+                            "host txids after device-seeded merkle "
+                            "mismatch")
+                        return await self.create_blocks(
+                            remaining, errors,
+                            _allow_device_txids=False)
                     return False
                 last_hash = block["hash"]
                 i += 1
