@@ -171,10 +171,11 @@ def _vote_tx(src_idx: int):
     return tx
 
 
-def _mine_header(merkle: str, ts: int, want_valid=True) -> BlockHeader:
+def _mine_header(merkle: str, ts: int, want_valid=True,
+                 address=None) -> BlockHeader:
     """Header with the first nonce whose PoW verdict is ``want_valid``
     (one search loop for both the valid and bad-PoW cases)."""
-    header = BlockHeader(previous_hash=H_PREV, address=ADDR_A,
+    header = BlockHeader(previous_hash=H_PREV, address=address or ADDR_A,
                          merkle_root=merkle, timestamp=ts,
                          difficulty_x10=10, nonce=0)
     prefix = header.prefix_bytes()
@@ -384,6 +385,209 @@ def test_check_block_differential_directed(name, builder, expected):
             ref, sc, content, txs_wire, last)
         assert ref_v == our_v, (name, ref_v, our_v, ref_e, our_e)
         assert our_v is expected, (name, our_v, our_e)
+
+    asyncio.run(main())
+
+
+# --------------------------------------------------------- create_block --
+
+GENESIS_ADDR = ADDR_B  # the genesis miner key for the emission gate
+GENESIS_CONTENT = BlockHeader(
+    previous_hash="00" * 32, address=GENESIS_ADDR,
+    merkle_root=hashlib.sha256(b"").hexdigest(), timestamp=T0 - 10_000,
+    difficulty_x10=10, nonce=0).hex()
+
+# last id 110: >= BLOCKS_COUNT(100) and not a retarget boundary, so both
+# sides carry the previous difficulty (1.0); block_no 111 is inside the
+# genesis-key window (<= 10000)
+CREATE_LAST = {"id": 110, "hash": H_PREV, "timestamp": T0,
+               "difficulty": Decimal("1.0"), "address": ADDR_A}
+
+
+class _WriteRecorder:
+    def __init__(self):
+        self.writes = []
+
+
+class RefCreateDb(RefBlockDb, _WriteRecorder):
+    """check_block fakes + the create_block read/write surface
+    (manager.py:650-757), recording the write set for comparison."""
+
+    def __init__(self, sc):
+        RefBlockDb.__init__(self, sc)
+        _WriteRecorder.__init__(self)
+
+    async def get_last_block(self):
+        return dict(CREATE_LAST)
+
+    async def get_block_by_id(self, block_id):
+        return None
+
+    async def get_genesis_block(self):
+        return GENESIS_CONTENT
+
+    async def add_block(self, block_no, block_hash, content, address,
+                        random_, difficulty, reward, ts):
+        # reference reward is Decimal coins; normalize to smallest units
+        self.writes.append(("block", block_no, block_hash, content, address,
+                            int(random_), str(Decimal(str(difficulty))),
+                            int(Decimal(str(reward)) * SMALLEST), int(ts)))
+
+    async def add_transaction(self, tx, block_hash):
+        self.writes.append(("coinbase", block_hash, tx.hex()))
+
+    async def add_transactions(self, txs, block_hash):
+        self.writes.append(
+            ("txs", block_hash, tuple(sorted(t.hex() for t in txs))))
+
+    async def add_transaction_outputs(self, txs):
+        self.writes.append(
+            ("outputs", tuple(sorted(t.hex() for t in txs))))
+
+    async def remove_pending_transactions_by_hash(self, hashes):
+        self.writes.append(("rm_pending", tuple(sorted(hashes))))
+
+    async def remove_outputs(self, txs):
+        self.writes.append(
+            ("rm_outputs", tuple(sorted(t.hex() for t in txs))))
+
+    async def remove_pending_spent_outputs(self, txs):
+        pass  # ours folds this into remove_outputs (overlay design)
+
+    async def delete_block(self, block_no):
+        self.writes.append(("delete_block", block_no))
+
+    async def get_unspent_outputs_hash(self):
+        return "00" * 32
+
+
+class OurCreateState(OurBlockState, _WriteRecorder):
+    def __init__(self, sc):
+        OurBlockState.__init__(self, sc)
+        _WriteRecorder.__init__(self)
+
+    async def get_last_block(self):
+        return dict(CREATE_LAST)
+
+    async def get_block_by_id(self, block_id):
+        if block_id == 1:
+            return {"id": 1, "content": GENESIS_CONTENT}
+        return None
+
+    def atomic(self):
+        import contextlib
+
+        @contextlib.asynccontextmanager
+        async def cm():
+            yield
+
+        return cm()
+
+    async def add_block(self, block_no, block_hash, content, address,
+                        nonce, difficulty, reward, ts):
+        self.writes.append(("block", block_no, block_hash, content, address,
+                            int(nonce), str(Decimal(str(difficulty))),
+                            int(reward), int(ts)))
+
+    async def add_transaction(self, tx, block_hash):
+        self.writes.append(("coinbase", block_hash, tx.hex()))
+
+    async def add_transactions(self, txs, block_hash):
+        self.writes.append(
+            ("txs", block_hash, tuple(sorted(t.hex() for t in txs))))
+
+    async def add_transaction_outputs(self, txs):
+        self.writes.append(
+            ("outputs", tuple(sorted(t.hex() for t in txs))))
+
+    async def remove_pending_transactions_by_hash(self, hashes):
+        self.writes.append(("rm_pending", tuple(sorted(hashes))))
+
+    async def remove_outputs(self, txs):
+        self.writes.append(
+            ("rm_outputs", tuple(sorted(t.hex() for t in txs))))
+
+    async def get_unspent_outputs_hash(self):
+        return "00" * 32
+
+    def record_emission(self, block_no, rows):
+        pass
+
+
+async def _both_create(ref, sc, content, txs_wire):
+    import upow.database as ref_db_mod
+    import upow.helpers as ref_helpers
+    import upow.manager as ref_manager
+    import upow_tpu.verify.block as our_block_mod
+
+    ref_db = RefCreateDb(sc)
+    ref_db_mod.Database.instance = ref_db
+    prev_ts_fn = ref_manager.timestamp
+    prev_sync = getattr(ref_helpers, "is_blockchain_syncing", False)
+    ref_manager.timestamp = lambda: NOW
+    ref_helpers.is_blockchain_syncing = False
+    try:
+        ref_txs = [await ref.Transaction.from_hex(w, check_signatures=False)
+                   for w in txs_wire]
+        ref_errors: list = []
+        ref_ok = await ref_manager.create_block(
+            content, ref_txs, error_list=ref_errors)
+    finally:
+        ref_manager.timestamp = prev_ts_fn
+        ref_helpers.is_blockchain_syncing = prev_sync
+        ref_db_mod.Database.instance = None
+
+    prev_now = our_block_mod.now_ts
+    our_block_mod.now_ts = lambda: NOW
+    try:
+        our_state = OurCreateState(sc)
+        manager = BlockManager(our_state, sig_backend="host")
+        our_txs = [tx_from_hex(w, check_signatures=False) for w in txs_wire]
+        our_errors: list = []
+        our_ok = await manager.create_block(content, our_txs,
+                                            errors=our_errors)
+    finally:
+        our_block_mod.now_ts = prev_now
+    return (bool(ref_ok), ref_db.writes, ref_errors,
+            bool(our_ok), our_state.writes, our_errors)
+
+
+@pytest.mark.parametrize("miner,inodes,expect_ok", [
+    ("genesis", 0, True),    # genesis-key window, no inodes
+    ("outsider", 0, False),  # emission gate rejects
+    ("outsider", 3, True),   # inode split carries the emission
+    ("genesis", 2, True),    # genesis miner + split
+], ids=["genesis-key", "emission-gate", "inode-split", "genesis+split"])
+def test_create_block_write_set_differential(miner, inodes, expect_ok):
+    """create_block write-set differential: both implementations accept
+    the same mined block and persist byte-identical rows — block row,
+    coinbase hex (incl. the inode 50/50 split outputs), tx set, pending
+    removals (manager.py:650-757)."""
+    ref = load_reference()
+
+    async def main():
+        sc = _base_scenario()
+        addr_miner = GENESIS_ADDR if miner == "genesis" else ADDR_A
+        sc["active_inodes"] = [
+            {"wallet": point_to_string(curve.keygen(rng=0x1A0 + i)[1]),
+             "emission": Decimal(100) / max(inodes, 1),
+             "power": Decimal(10)}
+            for i in range(inodes)
+        ]
+        tx = _send_tx(0, 5)
+        txs = [tx]
+        content = _mine_header(merkle_root(txs), T0 + 60,
+                               address=addr_miner).hex()
+
+        (ref_ok, ref_writes, ref_e,
+         our_ok, our_writes, our_e) = await _both_create(
+            ref, sc, content, [t.hex() for t in txs])
+        assert ref_ok == our_ok, (ref_ok, our_ok, ref_e, our_e)
+        assert our_ok is expect_ok, (our_ok, our_e)
+        if expect_ok:
+            assert ref_writes == our_writes, (ref_writes, our_writes)
+        else:
+            assert ref_writes == our_writes == []
 
     asyncio.run(main())
 

@@ -100,7 +100,8 @@ class RefFakeDb:
         wallets = list(self.sc["active_inodes"])
         if check_pending_txs:
             wallets += list(self.sc["active_inodes_pending"])
-        return [{"wallet": w} for w in wallets]
+        return [w if isinstance(w, dict) else {"wallet": w}
+                for w in wallets]
 
     async def get_delegates_all_power(self, address):
         return [object()] if _addr_flags(self.sc, address).get(
@@ -167,7 +168,8 @@ class OurFakeState:
         wallets = list(self.sc["active_inodes"])
         if check_pending_txs:
             wallets += list(self.sc["active_inodes_pending"])
-        return [{"wallet": w} for w in wallets]
+        return [w if isinstance(w, dict) else {"wallet": w}
+                for w in wallets]
 
     async def get_delegates_all_power(self, address):
         return [object()] if _addr_flags(self.sc, address).get(
