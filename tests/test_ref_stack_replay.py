@@ -1,0 +1,297 @@
+"""Reference-stack fixture replay (VERDICT r4, item 10 / the dry-land
+half of item 3's cross-stack differential).
+
+The reference's own ``manager.create_block`` BUILDS a real chain here —
+its Database singleton is backed by an adapter over OUR sqlite
+ChainState, so every validation decision and every written row is the
+reference's, while the storage underneath is ours (the strongest
+available proof that the reference stack can operate on a database we
+maintain, short of a real PostgreSQL).  The resulting pages are then
+replayed byte-for-byte through OUR node's sync ingest (create_blocks)
+into a fresh node: no monkeypatched hashes, no synthetic whitelists —
+the chain's content-addressed tx hashes are the reference's own.
+
+Blocks are mined at the real START_DIFFICULTY (6.0) with the native C++
+search; the fixture includes plain sends and a stake (+delegate voting
+power) transaction signed through the reference's signing path.
+"""
+
+import asyncio
+import hashlib
+import time
+from decimal import Decimal
+
+from ref_loader import load_reference
+
+from upow_tpu.core import curve, point_to_string
+from upow_tpu.core.constants import SMALLEST
+from upow_tpu.core.header import BlockHeader
+from upow_tpu.core.merkle import merkle_root
+from upow_tpu.core.tx import tx_from_hex
+from upow_tpu.mine.engine import MiningJob, mine
+from upow_tpu.node.app import GENESIS_PREV_HASH
+from upow_tpu.state import ChainState
+
+_TABLES = {
+    "unspent": "unspent_outputs",
+    "inode": "inode_registration_output",
+    "vpow": "validators_voting_power",
+    "dpow": "delegates_voting_power",
+    "iballot": "inodes_ballot",
+    "vballot": "validators_ballot",
+}
+
+
+class RefDbAdapter:
+    """The reference Database surface, backed by our ChainState.
+
+    Reference objects cross the boundary as wire hex (the codecs are
+    differential-tested byte-identical); amounts convert between the
+    reference's Decimal coins and our int smallest units.
+    """
+
+    def __init__(self, state: ChainState):
+        self.state = state
+
+    # -- reads ----------------------------------------------------------
+    async def get_last_block(self):
+        b = await self.state.get_last_block()
+        if b is None:
+            return None
+        b = dict(b)
+        b["difficulty"] = Decimal(str(b["difficulty"]))
+        return b
+
+    async def get_block_by_id(self, block_id):
+        return await self.state.get_block_by_id(block_id)
+
+    async def get_genesis_block(self):
+        g = await self.state.get_block_by_id(1)
+        return g["content"] if g else None
+
+    async def _present(self, outpoints, table):
+        ex = await self.state.outpoints_exist(list(outpoints), table)
+        return [tuple(o) for o, ok in zip(outpoints, ex) if ok]
+
+    async def get_unspent_outputs(self, outpoints):
+        return await self._present(outpoints, _TABLES["unspent"])
+
+    async def get_inode_outputs(self, outpoints):
+        return await self._present(outpoints, _TABLES["inode"])
+
+    async def get_validator_voting_power_outputs(self, outpoints):
+        return await self._present(outpoints, _TABLES["vpow"])
+
+    async def get_delegates_voting_power_outputs(self, outpoints):
+        return await self._present(outpoints, _TABLES["dpow"])
+
+    async def get_inodes_ballot_outputs(self, outpoints):
+        return await self._present(outpoints, _TABLES["iballot"])
+
+    async def get_validators_ballot_outputs(self, outpoints):
+        return await self._present(outpoints, _TABLES["vballot"])
+
+    async def get_transactions_info(self, tx_hashes):
+        out = {}
+        for h in set(tx_hashes):
+            info = await self.state.get_transaction_info(h)
+            if info is not None:
+                out[h] = info
+        return out
+
+    async def get_pending_spent_outputs(self, outpoints):
+        return []
+
+    # -- rule lookups ---------------------------------------------------
+    async def get_active_inodes(self, check_pending_txs=False):
+        return await self.state.get_active_inodes(
+            check_pending_txs=check_pending_txs)
+
+    async def get_stake_outputs(self, address, check_pending_txs=False):
+        return await self.state.get_stake_outputs(
+            address, check_pending_txs=check_pending_txs)
+
+    async def is_inode_registered(self, address, check_pending_txs=False):
+        return await self.state.is_inode_registered(
+            address, check_pending_txs=check_pending_txs)
+
+    async def is_validator_registered(self, address, check_pending_txs=False):
+        return await self.state.is_validator_registered(
+            address, check_pending_txs=check_pending_txs)
+
+    async def get_delegates_all_power(self, address):
+        return await self.state.get_delegates_all_power(address)
+
+    async def get_delegates_spent_votes(self, address):
+        return await self.state.get_delegates_spent_votes(address)
+
+    async def get_inode_registration_outputs(self, address):
+        return await self.state.get_inode_registration_outputs(address)
+
+    async def is_revoke_valid(self, tx_hash):
+        return await self.state.is_revoke_valid(tx_hash)
+
+    async def get_pending_stake_transaction(self, address):
+        return []  # fixture build bypasses the mempool
+
+    async def get_pending_vote_as_delegate_transaction(self, address):
+        return []
+
+    # -- writes (reference objects -> wire hex -> our objects) ----------
+    @staticmethod
+    def _ours(ref_tx):
+        return tx_from_hex(ref_tx.hex(), check_signatures=False)
+
+    async def add_block(self, block_no, block_hash, content, address,
+                        random_, difficulty, reward, ts):
+        await self.state.add_block(
+            block_no, block_hash, content, address, int(random_),
+            Decimal(str(difficulty)),
+            int(Decimal(str(reward)) * SMALLEST), int(ts))
+
+    async def add_transaction(self, tx, block_hash):
+        await self.state.add_transaction(self._ours(tx), block_hash)
+
+    async def add_transactions(self, txs, block_hash):
+        await self.state.add_transactions(
+            [self._ours(t) for t in txs], block_hash)
+
+    async def add_transaction_outputs(self, txs):
+        await self.state.add_transaction_outputs(
+            [self._ours(t) for t in txs])
+
+    async def remove_pending_transactions_by_hash(self, hashes):
+        pass
+
+    async def remove_outputs(self, txs):
+        await self.state.remove_outputs([self._ours(t) for t in txs])
+
+    async def remove_pending_spent_outputs(self, txs):
+        pass
+
+    async def delete_block(self, block_no):
+        raise AssertionError(f"reference rolled back block {block_no}")
+
+    async def get_unspent_outputs_hash(self):
+        return await self.state.get_unspent_outputs_hash()
+
+
+def _mine_content(prev_hash, address, merkle, ts, difficulty) -> str:
+    header = BlockHeader(previous_hash=prev_hash, address=address,
+                         merkle_root=merkle, timestamp=ts,
+                         difficulty_x10=int(difficulty * 10), nonce=0)
+    job = MiningJob(header.prefix_bytes(), prev_hash, difficulty)
+    result = mine(job, "native", batch=1 << 23, ttl=600)
+    assert result.nonce is not None, "native search found no nonce"
+    header.nonce = result.nonce
+    return header.hex()
+
+
+def test_reference_built_chain_replays_through_our_sync(tmp_path):
+    ref = load_reference()
+    import upow.database as ref_db_mod
+    import upow.manager as ref_manager
+    from upow.upow_transactions import (Transaction, TransactionInput,
+                                        TransactionOutput)
+    from upow.helpers import OutputType as RefOutputType
+
+    d_g, pub_g = curve.keygen(rng=0x6E11)
+    addr_g = point_to_string(pub_g)
+    d_r, pub_r = curve.keygen(rng=0x6E12)
+    addr_r = point_to_string(pub_r)
+
+    builder_state = ChainState(str(tmp_path / "builder.db"))
+    ref_db_mod.Database.instance = RefDbAdapter(builder_state)
+
+    ts0 = int(time.time()) - 3600
+
+    async def build_chain():
+        async def accept(txs, ts):
+            difficulty, last = await ref_manager.calculate_difficulty()
+            prev = last["hash"] if last else None
+            merkle = merkle_root([t.hex() for t in txs])
+            if prev is None:
+                header = BlockHeader(
+                    previous_hash=GENESIS_PREV_HASH,
+                    address=addr_g, merkle_root=merkle, timestamp=ts,
+                    difficulty_x10=int(difficulty * 10), nonce=0)
+                content = header.hex()
+            else:
+                content = _mine_content(prev, addr_g, merkle, ts,
+                                        difficulty)
+            errors = []
+            ok = await ref_manager.create_block(content, txs,
+                                                error_list=errors)
+            assert ok, errors
+            bhash = hashlib.sha256(bytes.fromhex(content)).hexdigest()
+            return bhash
+
+        async def coinbase_of(block_hash):
+            hashes = await builder_state.get_block_transaction_hashes(
+                block_hash)
+            assert len(hashes) >= 1
+            return hashes[0]  # coinbase is written first
+
+        b1 = await accept([], ts0)
+        b2 = await accept([], ts0 + 60)
+        b3 = await accept([], ts0 + 120)
+
+        # send 2 coins from block-1's coinbase (6-coin reward) to addr_r
+        cb1 = await coinbase_of(b1)
+        tx_send = Transaction(
+            [TransactionInput(cb1, 0, private_key=d_g)],
+            [TransactionOutput(addr_r, Decimal(2)),
+             TransactionOutput(addr_g, Decimal(4))])
+        tx_send.sign()
+        await accept([tx_send], ts0 + 180)
+
+        # stake 3 coins from block-2's coinbase (first stake: exactly-10
+        # delegate voting power minted alongside)
+        cb2 = await coinbase_of(b2)
+        tx_stake = Transaction(
+            [TransactionInput(cb2, 0, private_key=d_g)],
+            [TransactionOutput(addr_g, Decimal(3), RefOutputType.STAKE),
+             TransactionOutput(addr_g, Decimal(10),
+                               RefOutputType.DELEGATE_VOTING_POWER),
+             TransactionOutput(addr_g, Decimal(3))])
+        tx_stake.sign()
+        await accept([tx_stake], ts0 + 240)
+
+        # another send, spending block-3's coinbase
+        cb3 = await coinbase_of(b3)
+        tx_send2 = Transaction(
+            [TransactionInput(cb3, 0, private_key=d_g)],
+            [TransactionOutput(addr_r, Decimal(6))])
+        tx_send2.sign()
+        await accept([tx_send2], ts0 + 300)
+
+    async def replay_and_check():
+        pages = await builder_state.get_blocks(1, 500)
+        assert len(pages) == 6
+
+        from test_node import Cluster  # conftest puts tests/ on sys.path
+
+        cluster = Cluster(tmp_path)
+        try:
+            node_b, _client = await cluster.add_node("replay")
+            errors = []
+            ok = await node_b.create_blocks(pages, errors=errors)
+            assert ok, errors
+            assert (await node_b.state.get_last_block())["id"] == 6
+            assert (await builder_state.get_unspent_outputs_hash()
+                    == await node_b.state.get_unspent_outputs_hash())
+            # balances through our query paths on the replayed chain
+            assert (await node_b.state.get_address_balance(addr_r)
+                    == 8 * SMALLEST)
+            stakes = await node_b.state.get_stake_outputs(addr_g)
+            assert stakes, "stake output missing after replay"
+            assert await node_b.state.get_delegates_all_power(addr_g)
+        finally:
+            await cluster.close()
+
+    try:
+        asyncio.run(build_chain())
+        asyncio.run(replay_and_check())
+    finally:
+        ref_db_mod.Database.instance = None
+        builder_state.close()
