@@ -187,8 +187,50 @@ def _mine_content(prev_hash, address, merkle, ts, difficulty) -> str:
     return header.hex()
 
 
+async def _ref_accept(ref_manager, txs, ts, miner_addr):
+    """Mine a header for the current reference chain tip and accept it
+    through the reference's create_block; returns the block hash."""
+    difficulty, last = await ref_manager.calculate_difficulty()
+    prev = last["hash"] if last else None
+    merkle = merkle_root([t.hex() for t in txs])
+    if prev is None:
+        content = BlockHeader(
+            previous_hash=GENESIS_PREV_HASH, address=miner_addr,
+            merkle_root=merkle, timestamp=ts,
+            difficulty_x10=int(difficulty * 10), nonce=0).hex()
+    else:
+        content = _mine_content(prev, miner_addr, merkle, ts, difficulty)
+    errors = []
+    ok = await ref_manager.create_block(content, txs, error_list=errors)
+    assert ok, errors
+    return hashlib.sha256(bytes.fromhex(content)).hexdigest()
+
+
+async def _replay_into_fresh_node(tmp_path, builder_state, n_blocks, name,
+                                  extra_checks):
+    """Replay the builder chain's pages through a fresh node's sync
+    ingest, check fingerprint equality, then run ``extra_checks(state)``."""
+    pages = await builder_state.get_blocks(1, 500)
+    assert len(pages) == n_blocks
+
+    from test_node import Cluster  # conftest puts tests/ on sys.path
+
+    cluster = Cluster(tmp_path)
+    try:
+        node_b, _client = await cluster.add_node(name)
+        errors = []
+        ok = await node_b.create_blocks(pages, errors=errors)
+        assert ok, errors
+        assert (await node_b.state.get_last_block())["id"] == n_blocks
+        assert (await builder_state.get_unspent_outputs_hash()
+                == await node_b.state.get_unspent_outputs_hash())
+        await extra_checks(node_b.state)
+    finally:
+        await cluster.close()
+
+
 def test_reference_built_chain_replays_through_our_sync(tmp_path):
-    ref = load_reference()
+    load_reference()
     import upow.database as ref_db_mod
     import upow.manager as ref_manager
     from upow.upow_transactions import (Transaction, TransactionInput,
@@ -207,24 +249,7 @@ def test_reference_built_chain_replays_through_our_sync(tmp_path):
 
     async def build_chain():
         async def accept(txs, ts):
-            difficulty, last = await ref_manager.calculate_difficulty()
-            prev = last["hash"] if last else None
-            merkle = merkle_root([t.hex() for t in txs])
-            if prev is None:
-                header = BlockHeader(
-                    previous_hash=GENESIS_PREV_HASH,
-                    address=addr_g, merkle_root=merkle, timestamp=ts,
-                    difficulty_x10=int(difficulty * 10), nonce=0)
-                content = header.hex()
-            else:
-                content = _mine_content(prev, addr_g, merkle, ts,
-                                        difficulty)
-            errors = []
-            ok = await ref_manager.create_block(content, txs,
-                                                error_list=errors)
-            assert ok, errors
-            bhash = hashlib.sha256(bytes.fromhex(content)).hexdigest()
-            return bhash
+            return await _ref_accept(ref_manager, txs, ts, addr_g)
 
         async def coinbase_of(block_hash):
             hashes = await builder_state.get_block_transaction_hashes(
@@ -265,33 +290,139 @@ def test_reference_built_chain_replays_through_our_sync(tmp_path):
         tx_send2.sign()
         await accept([tx_send2], ts0 + 300)
 
-    async def replay_and_check():
-        pages = await builder_state.get_blocks(1, 500)
-        assert len(pages) == 6
-
-        from test_node import Cluster  # conftest puts tests/ on sys.path
-
-        cluster = Cluster(tmp_path)
-        try:
-            node_b, _client = await cluster.add_node("replay")
-            errors = []
-            ok = await node_b.create_blocks(pages, errors=errors)
-            assert ok, errors
-            assert (await node_b.state.get_last_block())["id"] == 6
-            assert (await builder_state.get_unspent_outputs_hash()
-                    == await node_b.state.get_unspent_outputs_hash())
-            # balances through our query paths on the replayed chain
-            assert (await node_b.state.get_address_balance(addr_r)
-                    == 8 * SMALLEST)
-            stakes = await node_b.state.get_stake_outputs(addr_g)
-            assert stakes, "stake output missing after replay"
-            assert await node_b.state.get_delegates_all_power(addr_g)
-        finally:
-            await cluster.close()
+    async def extra_checks(st):
+        # balances through our query paths on the replayed chain
+        assert await st.get_address_balance(addr_r) == 8 * SMALLEST
+        assert await st.get_stake_outputs(addr_g), "stake missing"
+        assert await st.get_delegates_all_power(addr_g)
 
     try:
         asyncio.run(build_chain())
-        asyncio.run(replay_and_check())
+        asyncio.run(_replay_into_fresh_node(
+            tmp_path, builder_state, 6, "replay", extra_checks))
+    finally:
+        ref_db_mod.Database.instance = None
+        builder_state.close()
+
+
+def test_reference_built_governance_chain_replays(tmp_path):
+    """The full delegate-governance lifecycle, built by the reference
+    stack and replayed through our sync: fund → stake (+first-time
+    voting-power mint) → validator registration → vote-as-delegate →
+    48 h-gated revoke → unstake.  Chain timestamps start three days in
+    the past so the revoke window is genuinely open at validation time
+    on BOTH stacks (no clock patching)."""
+    load_reference()
+    import upow.database as ref_db_mod
+    import upow.manager as ref_manager
+    from upow.upow_transactions import (Transaction, TransactionInput,
+                                        TransactionOutput)
+    from upow.helpers import OutputType as RefOT
+
+    d_g, pub_g = curve.keygen(rng=0x60F1)
+    addr_g = point_to_string(pub_g)
+    d_r, pub_r = curve.keygen(rng=0x60F2)
+    addr_r = point_to_string(pub_r)
+
+    builder_state = ChainState(str(tmp_path / "gov-builder.db"))
+    ref_db_mod.Database.instance = RefDbAdapter(builder_state)
+
+    ts0 = int(time.time()) - 3 * 86400
+    height = [0]
+
+    async def accept(txs):
+        height[0] += 1
+        return await _ref_accept(ref_manager, txs, ts0 + height[0] * 60,
+                                 addr_g)
+
+    async def build():
+        coinbases = []
+        for _ in range(20):
+            bh = await accept([])
+            hashes = await builder_state.get_block_transaction_hashes(bh)
+            coinbases.append(hashes[0])
+
+        C = Decimal(6)  # coinbase reward per block at this height
+
+        # fund r with 101 coins from 17 coinbase outputs (102 in)
+        tx_fund = Transaction(
+            [TransactionInput(h, 0, private_key=d_g)
+             for h in coinbases[:17]],
+            [TransactionOutput(addr_r, Decimal(101)),
+             TransactionOutput(addr_g, 17 * C - Decimal(101))])
+        tx_fund.sign()
+        # g stakes 3 from coinbase 18 (+ first-time 10-power mint)
+        tx_stake_g = Transaction(
+            [TransactionInput(coinbases[17], 0, private_key=d_g)],
+            [TransactionOutput(addr_g, Decimal(3), RefOT.STAKE),
+             TransactionOutput(addr_g, C - Decimal(3)),
+             TransactionOutput(addr_g, Decimal(10),
+                               RefOT.DELEGATE_VOTING_POWER)])
+        tx_stake_g.sign()
+        await accept([tx_fund, tx_stake_g])
+
+        # r stakes 0.5 (required before validator registration)
+        tx_stake_r = Transaction(
+            [TransactionInput(tx_fund.hash(), 0, private_key=d_r)],
+            [TransactionOutput(addr_r, Decimal("0.5"), RefOT.STAKE),
+             TransactionOutput(addr_r, Decimal("100.5")),
+             TransactionOutput(addr_r, Decimal(10),
+                               RefOT.DELEGATE_VOTING_POWER)])
+        tx_stake_r.sign()
+        await accept([tx_stake_r])
+
+        # r registers as validator: exactly 100 + one 10-power output
+        tx_vreg = Transaction(
+            [TransactionInput(tx_stake_r.hash(), 1, private_key=d_r)],
+            [TransactionOutput(addr_r, Decimal(100),
+                               RefOT.VALIDATOR_REGISTRATION),
+             TransactionOutput(addr_r, Decimal(10),
+                               RefOT.VALIDATOR_VOTING_POWER),
+             TransactionOutput(addr_r, Decimal("0.5"))],
+            message=b"5")
+        tx_vreg.sign()
+        await accept([tx_vreg])
+
+        # g votes 10 as delegate for validator r (spends g's power)
+        tx_vote = Transaction(
+            [TransactionInput(tx_stake_g.hash(), 2, private_key=d_g)],
+            [TransactionOutput(addr_r, Decimal(10),
+                               RefOT.VOTE_AS_DELEGATE)],
+            message=b"7")
+        tx_vote.sign()
+        await accept([tx_vote])
+
+        await accept([])  # spacing block
+
+        # g revokes (the vote block's timestamp is ~3 days old > 48 h)
+        tx_revoke = Transaction(
+            [TransactionInput(tx_vote.hash(), 0, private_key=d_g)],
+            [TransactionOutput(addr_g, Decimal(10),
+                               RefOT.DELEGATE_VOTING_POWER)],
+            message=b"9")
+        tx_revoke.sign()
+        await accept([tx_revoke])
+
+        # votes released: g can unstake
+        tx_unstake = Transaction(
+            [TransactionInput(tx_stake_g.hash(), 0, private_key=d_g)],
+            [TransactionOutput(addr_g, Decimal(3), RefOT.UN_STAKE)])
+        tx_unstake.sign()
+        await accept([tx_unstake])
+
+    async def extra_checks(st):
+        # replayed roles match the lifecycle's end state
+        assert await st.is_validator_registered(addr_r)
+        assert not await st.get_stake_outputs(addr_g)  # unstaked
+        assert await st.get_stake_outputs(addr_r)
+        assert await st.get_delegates_all_power(addr_g)  # revoked back
+        assert not await st.get_delegates_spent_votes(addr_g)
+
+    try:
+        asyncio.run(build())
+        assert height[0] == 27
+        asyncio.run(_replay_into_fresh_node(
+            tmp_path, builder_state, 27, "gov-replay", extra_checks))
     finally:
         ref_db_mod.Database.instance = None
         builder_state.close()
