@@ -241,6 +241,14 @@ def test_block_endpoints(tmp_path, keys):
         res = await (await client.get(
             "/get_block_details", params={"block": "2"})).json()
         assert res["ok"] and len(res["result"]["transactions"]) == 1
+        # tx_details page: explorer dicts instead of hex (this endpoint
+        # raised TypeError until round 4 — get_blocks lacked the kwarg)
+        res = await (await client.get(
+            "/get_blocks_details",
+            params={"offset": "1", "limit": "10"})).json()
+        assert res["ok"] and len(res["result"]) == 2
+        nice = res["result"][0]["transactions"][0]
+        assert isinstance(nice, dict) and nice["is_coinbase"]
         res = await (await client.get(
             "/get_block", params={"block": "aa" * 32})).json()
         assert not res["ok"]

@@ -569,9 +569,11 @@ def test_mock_executemany_is_atomic_like_asyncpg():
     drv.close()
 
 
-def test_pg_get_blocks_single_query_page(make_state):
+def test_pg_get_blocks_single_query_page(make_state, monkeypatch):
     """get_blocks serves a sync page with embedded transactions in two
-    driver round trips (blocks + one ANY() transactions fetch)."""
+    driver round trips (blocks + one ANY() transactions fetch), supports
+    the explorer tx_details form, and truncates the running page at 8
+    blocks' worth of hex like the reference (database.py:380-408)."""
 
     async def main():
         state = make_state()
@@ -593,6 +595,24 @@ def test_pg_get_blocks_single_query_page(make_state):
         assert tx.hex() in page[-1]["transactions"]
         assert all(isinstance(p["transactions"], list) for p in page)
         assert await state.get_blocks(99, 10) == []
+
+        # explorer form: dicts, not hex (the /get_blocks_details shape)
+        detail = await state.get_blocks(4, 1, tx_details=True)
+        nice = detail[0]["transactions"]
+        assert len(nice) == 2 and all(isinstance(t, dict) for t in nice)
+        assert any(t["is_coinbase"] for t in nice)
+        assert any(t["hash"] == tx.hash() for t in nice)
+
+        # response size cap (serving layer only): with the cap shrunk
+        # below one coinbase's hex, a capped page truncates immediately
+        # while internal callers still get the full window
+        import upow_tpu.state.pg as pg_mod
+        import upow_tpu.state.storage as storage_mod
+
+        monkeypatch.setattr(storage_mod, "MAX_BLOCK_SIZE_HEX", 1)
+        monkeypatch.setattr(pg_mod, "MAX_BLOCK_SIZE_HEX", 1)
+        assert await state.get_blocks(1, 10, size_capped=True) == []
+        assert len(await state.get_blocks(1, 10)) == 4  # uncapped: full
 
     run(main())
 

@@ -824,14 +824,16 @@ class Node:
         q = request.rel_url.query
         offset = int(q.get("offset", 0))
         limit = min(int(q.get("limit", 100)), 1000)
-        blocks = await self.state.get_blocks(offset, limit)
+        blocks = await self.state.get_blocks(offset, limit,
+                                             size_capped=True)
         return web.json_response({"ok": True, "result": blocks})
 
     async def h_get_blocks_details(self, request: web.Request) -> web.Response:
         q = request.rel_url.query
         offset = int(q.get("offset", 0))
         limit = min(int(q.get("limit", 100)), 1000)
-        blocks = await self.state.get_blocks(offset, limit, tx_details=True)
+        blocks = await self.state.get_blocks(offset, limit, tx_details=True,
+                                             size_capped=True)
         return web.json_response({"ok": True, "result": blocks})
 
     async def h_dobby_info(self, request: web.Request) -> web.Response:
@@ -940,11 +942,16 @@ class Node:
                         offset, cfg.sync_reorg_window)
                     local_blocks = await self.state.get_blocks(
                         offset, cfg.sync_reorg_window)
-                    local_blocks = local_blocks[: len(remote_blocks)]
+                    # pair by block id, not list index: the peer's page
+                    # may be size-truncated (reference-compatible cap),
+                    # so index alignment is not guaranteed
+                    remote_by_id = {rb["block"]["id"]: rb
+                                    for rb in remote_blocks}
                     local_blocks.reverse()
-                    remote_blocks.reverse()
                     for n, local in enumerate(local_blocks):
-                        if local["block"]["hash"] == remote_blocks[n]["block"]["hash"]:
+                        remote = remote_by_id.get(local["block"]["id"])
+                        if remote is not None and \
+                                local["block"]["hash"] == remote["block"]["hash"]:
                             last_common_block = local["block"]["id"]
                             local_cache = local_blocks[:n]
                             local_cache.reverse()

@@ -44,7 +44,7 @@ from decimal import Decimal
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 from ..core.clock import timestamp as now_ts
-from ..core.constants import SMALLEST
+from ..core.constants import MAX_BLOCK_SIZE_HEX, SMALLEST
 from ..core.tx import CoinbaseTx, Tx, TxInput, tx_from_hex
 from .pgdriver import AsyncpgDriver, MockPgDriver, _epoch, _utc
 from .storage import _GOV_TABLES, _INPUT_TABLE, _OUTPUT_TABLE
@@ -355,30 +355,45 @@ class PgChainState(StateViews):
         rows = await self.drv.afetch("SELECT MAX(id) AS m FROM blocks")
         return (rows[0]["m"] or 0) + 1
 
-    async def get_blocks(self, offset: int, limit: int) -> List[dict]:
-        """Blocks with embedded full transactions (database.py:380-437).
+    async def get_blocks(self, offset: int, limit: int,
+                         tx_details: bool = False,
+                         size_capped: bool = False) -> List[dict]:
+        """Blocks with embedded full transactions (database.py:380-408).
 
         One transactions query for the whole page (grouped host-side) —
         a 1000-block sync page is 2 round trips on the network-attached
-        driver, not 1001."""
+        driver, not 1001 (``tx_details`` swaps tx hex for
+        explorer-shaped dicts at the reference's per-tx lookup cost).
+        ``size_capped`` truncates the page at 8 full blocks' worth of
+        hex — passed by the HTTP serving layer only, so internal
+        callers (the reorg-window scan) always see the full window
+        (divergence note in the sqlite twin's docstring)."""
         rows = await self.drv.afetch(
             "SELECT * FROM blocks WHERE id >= $1 ORDER BY id LIMIT $2",
             (offset, limit))
         by_hash: dict = {r["hash"]: [] for r in rows}
         if rows:
             txs = await self.drv.afetch(
-                "SELECT block_hash, tx_hex FROM transactions"
+                "SELECT block_hash, tx_hash, tx_hex FROM transactions"
                 " WHERE block_hash = ANY($1)", (list(by_hash),))
             for t in txs:
-                by_hash[t["block_hash"]].append(t["tx_hex"])
+                by_hash[t["block_hash"]].append((t["tx_hash"], t["tx_hex"]))
         out = []
+        size = 0
         for r in rows:
+            txs_b = by_hash[r["hash"]]
+            size += sum(len(h) for _th, h in txs_b)
+            if size_capped and size > MAX_BLOCK_SIZE_HEX * 8:
+                break
             block = self._block_dict(r)
             block["difficulty"] = float(block["difficulty"])
             block["reward"] = str(block["reward"])
             out.append({
                 "block": block,
-                "transactions": by_hash[r["hash"]],
+                "transactions": (
+                    [h for _th, h in txs_b] if not tx_details else
+                    [await self.get_nice_transaction(th)
+                     for th, _h in txs_b]),
             })
         return out
 

@@ -37,7 +37,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 from ..core.clock import timestamp as now_ts
 from ..core.codecs import OutputType, TransactionType
-from ..core.constants import SMALLEST
+from ..core.constants import MAX_BLOCK_SIZE_HEX, SMALLEST
 from ..core.rewards import round_up_decimal
 from ..core.tx import CoinbaseTx, Tx, TxInput, tx_from_hex
 
@@ -356,25 +356,54 @@ class ChainState(StateViews):
         r = self.db.execute("SELECT MAX(id) AS m FROM blocks").fetchone()
         return (r["m"] or 0) + 1
 
-    async def get_blocks(self, offset: int, limit: int) -> List[dict]:
+    async def get_blocks(self, offset: int, limit: int,
+                         tx_details: bool = False,
+                         size_capped: bool = False) -> List[dict]:
         """Blocks with embedded full transactions, ordered by id
-        (reference database.py:380-437's get_blocks)."""
+        (reference database.py:380-408's get_blocks).
+
+        One transactions query for the whole page, grouped host-side —
+        a couple of statements per 500-block page instead of 501 (same
+        shape as the pg backend's; ``tx_details`` swaps the tx hex for
+        explorer-shaped dicts at the reference's per-tx lookup cost,
+        database.py:405).  ``size_capped`` truncates the running page
+        once the accumulated hex passes 8 full blocks' worth — the HTTP
+        serving layer passes it (a 1000-block page of 2 MB blocks must
+        not serialize a 2 GB response).  Documented divergence: the
+        reference caps INSIDE Database.get_blocks unconditionally,
+        which silently truncates its own reorg-window scan; we cap only
+        at the wire boundary so internal callers always see the full
+        window (and the reorg scan pairs blocks by id, app.py)."""
         rows = self.db.execute(
             "SELECT * FROM blocks WHERE id >= ? ORDER BY id LIMIT ?",
             (offset, limit),
         ).fetchall()
+        by_hash: dict = {r["hash"]: [] for r in rows}
+        hashes = list(by_hash)
+        # chunk the IN list: SQLITE_MAX_VARIABLE_NUMBER is 999 before
+        # sqlite 3.32, and the endpoint serves pages up to 1000 blocks
+        for lo in range(0, len(hashes), 900):
+            chunk = hashes[lo:lo + 900]
+            marks = ",".join("?" * len(chunk))
+            for t in self.db.execute(
+                    f"SELECT block_hash, tx_hash, tx_hex FROM transactions"
+                    f" WHERE block_hash IN ({marks})", chunk):
+                by_hash[t["block_hash"]].append((t["tx_hash"], t["tx_hex"]))
         out = []
+        size = 0
         for r in rows:
-            txs = self.db.execute(
-                "SELECT tx_hex FROM transactions WHERE block_hash = ?",
-                (r["hash"],),
-            ).fetchall()
+            txs = by_hash[r["hash"]]
+            size += sum(len(h) for _th, h in txs)
+            if size_capped and size > MAX_BLOCK_SIZE_HEX * 8:
+                break
             block = self._block_dict(r)
             block["difficulty"] = float(block["difficulty"])
             block["reward"] = str(block["reward"])
             out.append({
                 "block": block,
-                "transactions": [t["tx_hex"] for t in txs],
+                "transactions": (
+                    [h for _th, h in txs] if not tx_details else
+                    [await self.get_nice_transaction(th) for th, _h in txs]),
             })
         return out
 
