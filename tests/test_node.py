@@ -256,6 +256,113 @@ def test_block_endpoints(tmp_path, keys):
     run_cluster(tmp_path, scenario)
 
 
+def test_governance_info_endpoints(tmp_path, keys):
+    """The three explorer endpoints with no prior coverage (the
+    /get_blocks_details TypeError hid for three rounds behind exactly
+    this gap): /get_validators_info, /get_delegates_info, /dobby_info —
+    exercised against populated ballots, plus a smoke GET over every
+    read endpoint asserting parseable ok JSON."""
+
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        node.rate_limiter.enabled = False  # ~200 blocks mined via API
+        from upow_tpu.wallet.builders import WalletBuilder
+
+        builder = WalletBuilder(node.state)
+        d_g, a_g = keys["d"], keys["addr"]
+        for _ in range(22):  # validator registration needs 100 coins
+            await mine_via_api(client, a_g)
+        # governance state: stake -> validator-register -> delegate vote
+        tx = await builder.create_stake_transaction(d_g, "3")
+        await node.state.add_pending_transaction(tx)
+        await mine_via_api(client, a_g)
+        tx = await builder.create_validator_registration_transaction(d_g)
+        await node.state.add_pending_transaction(tx)
+        await mine_via_api(client, a_g)
+        # a second actor stakes and votes for the validator
+        d_o, a_o = keys["d2"], keys["addr2"]
+        tx = await builder.create_transaction(d_g, a_o, "20")
+        await node.state.add_pending_transaction(tx)
+        await mine_via_api(client, a_g)
+        tx = await builder.create_stake_transaction(d_o, "1")
+        await node.state.add_pending_transaction(tx)
+        await mine_via_api(client, a_g)
+        tx = await builder.vote_as_delegate(d_o, 10, a_g)
+        await node.state.add_pending_transaction(tx)
+        await mine_via_api(client, a_g)
+
+        # before any inode ballot exists: empty list, not an error
+        assert await (await client.get("/get_validators_info")).json() == []
+        res = await (await client.get("/dobby_info")).json()
+        assert res["ok"] and res["result"] == []
+
+        # populate the inode ballot too: a third actor becomes an inode
+        # (1000 coins) and the validator votes for it
+        from upow_tpu.core import curve as _curve, point_to_string as _pts
+
+        d_i, pub_i = _curve.keygen(rng=0x1B0D)
+        a_i = _pts(pub_i)
+        for _ in range(170):  # fund the inode registration
+            await mine_via_api(client, a_g)
+        for chunk in ("400", "400", "210"):  # <256 inputs per send
+            tx = await builder.create_transaction(d_g, a_i, chunk)
+            await node.state.add_pending_transaction(tx)
+            await mine_via_api(client, a_g)
+        tx = await builder.create_stake_transaction(d_i, "1")
+        await node.state.add_pending_transaction(tx)
+        await mine_via_api(client, a_g)
+        tx = await builder.create_inode_registration_transaction(d_i)
+        await node.state.add_pending_transaction(tx)
+        await mine_via_api(client, a_g)
+        tx = await builder.vote_as_validator(d_g, 10, a_i)
+        await node.state.add_pending_transaction(tx)
+        await mine_via_api(client, a_g)
+
+        validators = await (await client.get("/get_validators_info")).json()
+        assert isinstance(validators, list) and len(validators) == 1
+        assert validators[0]["validator"] == a_g
+        assert validators[0]["vote"][0]["wallet"] == a_i
+        filtered = await (await client.get(
+            "/get_validators_info", params={"inode": a_i})).json()
+        assert len(filtered) == 1
+
+        # these two return BARE lists — reference parity, main.py:725/764
+        delegates = await (await client.get("/get_delegates_info")).json()
+        assert isinstance(delegates, list), delegates
+        assert len(delegates) == 1 and delegates[0]["delegate"] == a_o
+        assert delegates[0]["vote"][0]["wallet"] == a_g
+        assert Decimal(delegates[0]["totalStake"]) == 1
+
+        filtered = await (await client.get(
+            "/get_delegates_info", params={"validator": a_g})).json()
+        assert len(filtered) == 1
+
+        # smoke matrix: every read endpoint answers parseable JSON with
+        # its documented shape (ok envelope or reference bare list)
+        for path, params, bare_list in [
+            ("/get_address_info", {"address": a_g}, False),
+            ("/get_address_transactions", {"address": a_g}, False),
+            ("/get_block", {"block": "1"}, False),
+            ("/get_block_details", {"block": "1"}, False),
+            ("/get_blocks", {"offset": "1", "limit": "10"}, False),
+            ("/get_blocks_details", {"offset": "1", "limit": "10"}, False),
+            ("/get_delegates_info", {}, True),
+            ("/get_mining_info", {}, False),
+            ("/get_nodes", {}, False),
+            ("/get_pending_transactions", {}, False),
+            ("/get_supply_info", {}, False),
+            ("/get_validators_info", {}, True),
+            ("/dobby_info", {}, False),
+        ]:
+            res = await (await client.get(path, params=params)).json()
+            if bare_list:
+                assert isinstance(res, list), (path, res)
+            else:
+                assert res.get("ok"), (path, res)
+
+    run_cluster(tmp_path, scenario)
+
+
 # --------------------------------------------------------------- gossip ----
 
 def test_gossip_block_propagation(tmp_path, keys):

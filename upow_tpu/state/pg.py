@@ -388,13 +388,15 @@ class PgChainState(StateViews):
             block = self._block_dict(r)
             block["difficulty"] = float(block["difficulty"])
             block["reward"] = str(block["reward"])
-            out.append({
-                "block": block,
-                "transactions": (
-                    [h for _th, h in txs_b] if not tx_details else
-                    [await self.get_nice_transaction(th)
-                     for th, _h in txs_b]),
-            })
+            if tx_details:
+                # per-tx lookups are inherent to the explorer shape
+                # (see the sqlite twin's note); drop reorg-raced Nones
+                nice = [await self.get_nice_transaction(th)
+                        for th, _h in txs_b]
+                tx_list = [t for t in nice if t is not None]
+            else:
+                tx_list = [h for _th, h in txs_b]
+            out.append({"block": block, "transactions": tx_list})
         return out
 
     async def remove_blocks(self, from_block_id: int) -> None:

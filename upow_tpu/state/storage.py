@@ -399,12 +399,18 @@ class ChainState(StateViews):
             block = self._block_dict(r)
             block["difficulty"] = float(block["difficulty"])
             block["reward"] = str(block["reward"])
-            out.append({
-                "block": block,
-                "transactions": (
-                    [h for _th, h in txs] if not tx_details else
-                    [await self.get_nice_transaction(th) for th, _h in txs]),
-            })
+            if tx_details:
+                # per-tx lookups are inherent to the explorer shape
+                # (fees + per-input amounts need resolution; the
+                # reference pays the same, database.py:405).  A tx can
+                # vanish mid-page under a concurrent reorg — drop the
+                # None instead of embedding null in the response.
+                nice = [await self.get_nice_transaction(th)
+                        for th, _h in txs]
+                tx_list = [t for t in nice if t is not None]
+            else:
+                tx_list = [h for _th, h in txs]
+            out.append({"block": block, "transactions": tx_list})
         return out
 
     async def remove_blocks(self, from_block_id: int) -> None:
