@@ -72,6 +72,44 @@ def test_native_p256_verify_valid_and_invalid():
     assert native.p256_verify(digest, r, CURVE_N - s, *pub) is True
 
 
+def test_native_p256_strauss_randomized_differential():
+    """The round-4 Jacobian Strauss rewrite vs the pure-python oracle:
+    valid / corrupted-s / malleability-twin / wrong-key / out-of-range
+    over 200 randomized cases, plus tiny keys (d = 1, 2, 3 — Q equal or
+    close to G) that drive the walk into its H == 0 same-point branches
+    where the old always-add complete ladder had no branches to get
+    wrong."""
+    import random as _random
+
+    prng = _random.Random("native-strauss")
+    for trial in range(200):
+        d, pub = curve.keygen(rng=prng.getrandbits(64) or 1)
+        msg = prng.getrandbits(256).to_bytes(32, "big")
+        digest = hashlib.sha256(msg).digest()
+        r, s = curve.sign(msg, d)
+        case = trial % 5
+        if case == 1:
+            s = (s + 1) % CURVE_N or 1
+        elif case == 2:
+            s = CURVE_N - s  # malleability twin: stays valid
+        elif case == 3:
+            pub = curve.keygen(rng=7)[1]  # wrong key
+        elif case == 4:
+            s = CURVE_N  # out of range
+        want = curve.verify((r, s), msg, pub)
+        got = native.p256_verify(digest, r, s, pub[0], pub[1])
+        assert got == want, (trial, case, want, got)
+
+    for d in (1, 2, 3):  # Q == G / 2G / 3G: table adds collide with G's
+        pub = curve.point_mul(d, curve.G)
+        msg = b"degenerate key %d" % d
+        r, s = curve.sign(msg, d)
+        digest = hashlib.sha256(msg).digest()
+        assert native.p256_verify(digest, r, s, pub[0], pub[1]) is True
+        assert native.p256_verify(digest, r, (s + 1) % CURVE_N,
+                                  pub[0], pub[1]) is False
+
+
 def test_native_p256_batch_matches_python_oracle():
     digests, sigs, pubs, want = [], [], [], []
     for i in range(12):

@@ -6,10 +6,13 @@
 // (upow/upow_transactions/transaction_input.py:100-109).  Python binds via
 // ctypes (upow_tpu/native/__init__.py); no pybind11 in the image.
 //
-// The P-256 implementation mirrors the TPU kernel's structure — Montgomery
-// field arithmetic + Renes–Costello–Batina complete projective addition —
-// so the two fast paths share a verification-friendly, branch-free design
-// and cross-check each other in tests.
+// The P-256 implementation mirrors the TPU kernel's production path —
+// Montgomery field arithmetic + the same Jacobian formula set
+// (dbl-2001-b, add/madd-2007-bl) in a 4-bit-window Strauss walk — but
+// where the kernel handles formula degeneracies with lane flags (no
+// branches on device), the CPU handles them with explicit branches:
+// verify-only code with nothing secret to leak.  The two fast paths
+// cross-check each other in tests.
 
 #include <cstdint>
 #include <cstring>
@@ -320,42 +323,6 @@ static inline bool eq(const Fe& a, const Fe& b) {
           (a.v[3] ^ b.v[3])) == 0;
 }
 
-struct Pt { Fe X, Y, Z; };  // homogeneous projective, Montgomery domain
-
-// RCB16 Algorithm 4 (a = -3): complete projective addition — identical
-// straight-line program to the TPU kernel in upow_tpu/crypto/p256.py.
-static void add_complete(Pt& R, const Pt& Pp, const Pt& Q) {
-  const Fe &X1 = Pp.X, &Y1 = Pp.Y, &Z1 = Pp.Z;
-  const Fe &X2 = Q.X, &Y2 = Q.Y, &Z2 = Q.Z;
-  Fe t0, t1, t2, t3, t4, X3, Y3, Z3;
-#define MUL(r, a, b) mont_mul(r, a, b, P, P_INV)
-#define ADD(r, a, b) add_mod(r, a, b, P)
-#define SUB(r, a, b) sub_mod(r, a, b, P)
-  MUL(t0, X1, X2); MUL(t1, Y1, Y2); MUL(t2, Z1, Z2);
-  ADD(t3, X1, Y1); ADD(t4, X2, Y2); MUL(t3, t3, t4);
-  ADD(t4, t0, t1); SUB(t3, t3, t4); ADD(t4, Y1, Z1);
-  ADD(X3, Y2, Z2); MUL(t4, t4, X3); ADD(X3, t1, t2);
-  SUB(t4, t4, X3); ADD(X3, X1, Z1); ADD(Y3, X2, Z2);
-  MUL(X3, X3, Y3); ADD(Y3, t0, t2); SUB(Y3, X3, Y3);
-  MUL(Z3, B_M, t2); SUB(X3, Y3, Z3); ADD(Z3, X3, X3);
-  ADD(X3, X3, Z3); SUB(Z3, t1, X3); ADD(X3, t1, X3);
-  MUL(Y3, B_M, Y3); ADD(t1, t2, t2); ADD(t2, t1, t2);
-  SUB(Y3, Y3, t2); SUB(Y3, Y3, t0); ADD(t1, Y3, Y3);
-  ADD(Y3, t1, Y3); ADD(t1, t0, t0); ADD(t0, t1, t0);
-  SUB(t0, t0, t2); MUL(t1, t4, Y3); MUL(t2, t0, Y3);
-  MUL(Y3, X3, Z3); ADD(Y3, Y3, t2); MUL(t2, t3, X3);
-  SUB(X3, t2, t1); MUL(t2, t4, Z3); MUL(t1, t3, t0);
-  ADD(Z3, t2, t1);
-#undef MUL
-#undef ADD
-#undef SUB
-  R.X = X3; R.Y = Y3; R.Z = Z3;
-}
-
-static void cmov(Pt& r, const Pt& a, bool take) {
-  if (take) r = a;  // verify-only: no constant-time requirement
-}
-
 static void from_be32(Fe& r, const uint8_t* be) {
   for (int i = 0; i < 4; i++) {
     uint64_t w = 0;
@@ -374,6 +341,134 @@ static void mont_pow(Fe& r, const Fe& a_m, const Fe& e, const Fe& mod,
   }
   r = acc;
 }
+
+// ---- Jacobian arithmetic (verify-only: data-dependent branches are
+// fine, there is no secret to leak).  Same formula choices as the TPU
+// kernel (dbl-2001-b a=-3, add-2007-bl, madd-2007-bl) but with the
+// exceptional cases handled by explicit branches instead of lane flags.
+
+struct Jac { Fe X, Y, Z; };  // Z == 0 encodes infinity
+
+#define PMUL(r, a, b) mont_mul(r, a, b, P, P_INV)
+#define PADD(r, a, b) add_mod(r, a, b, P)
+#define PSUB(r, a, b) sub_mod(r, a, b, P)
+
+static void jac_dbl(Jac& R, const Jac& Pp) {
+  // dbl-2001-b (a = -3): 3M + 5S
+  if (is_zero(Pp.Z)) { R = Pp; return; }
+  Fe delta, gamma, beta, alpha, t0, t1, t2;
+  PMUL(delta, Pp.Z, Pp.Z);
+  PMUL(gamma, Pp.Y, Pp.Y);
+  PMUL(beta, Pp.X, gamma);
+  PSUB(t0, Pp.X, delta); PADD(t1, Pp.X, delta);
+  PMUL(alpha, t0, t1);
+  PADD(t0, alpha, alpha); PADD(alpha, t0, alpha);  // alpha *= 3
+  Fe X3, Y3, Z3;
+  PMUL(X3, alpha, alpha);
+  PADD(t0, beta, beta); PADD(t0, t0, t0); PADD(t0, t0, t0);  // 8*beta
+  PSUB(X3, X3, t0);
+  PADD(Z3, Pp.Y, Pp.Z); PMUL(Z3, Z3, Z3);
+  PSUB(Z3, Z3, gamma); PSUB(Z3, Z3, delta);
+  PADD(t0, beta, beta); PADD(t0, t0, t0);  // 4*beta
+  PSUB(t0, t0, X3); PMUL(Y3, alpha, t0);
+  PMUL(t1, gamma, gamma);
+  PADD(t2, t1, t1); PADD(t2, t2, t2); PADD(t2, t2, t2);  // 8*gamma^2
+  PSUB(Y3, Y3, t2);
+  R.X = X3; R.Y = Y3; R.Z = Z3;
+}
+
+static void jac_add(Jac& R, const Jac& Pp, const Jac& Q) {
+  // add-2007-bl: 11M + 5S, with branch handling for the degeneracies
+  if (is_zero(Pp.Z)) { R = Q; return; }
+  if (is_zero(Q.Z)) { R = Pp; return; }
+  Fe Z1Z1, Z2Z2, U1, U2, S1, S2, t;
+  PMUL(Z1Z1, Pp.Z, Pp.Z); PMUL(Z2Z2, Q.Z, Q.Z);
+  PMUL(U1, Pp.X, Z2Z2); PMUL(U2, Q.X, Z1Z1);
+  PMUL(t, Q.Z, Z2Z2); PMUL(S1, Pp.Y, t);
+  PMUL(t, Pp.Z, Z1Z1); PMUL(S2, Q.Y, t);
+  Fe H, Rr;
+  PSUB(H, U2, U1); PSUB(Rr, S2, S1);
+  if (is_zero(H)) {
+    if (is_zero(Rr)) { jac_dbl(R, Pp); return; }  // P == Q
+    R.X = ONE_M; R.Y = ONE_M;                     // P == -Q: infinity
+    R.Z = Fe{{0, 0, 0, 0}};
+    return;
+  }
+  PADD(Rr, Rr, Rr);  // r = 2*(S2-S1)
+  Fe I, J, V;
+  PADD(t, H, H); PMUL(I, t, t);       // I = (2H)^2
+  PMUL(J, H, I);                       // J = H*I
+  PMUL(V, U1, I);                      // V = U1*I
+  Fe X3, Y3, Z3;
+  PMUL(X3, Rr, Rr); PSUB(X3, X3, J);
+  PSUB(X3, X3, V); PSUB(X3, X3, V);
+  PSUB(t, V, X3); PMUL(Y3, Rr, t);
+  PMUL(t, S1, J); PADD(t, t, t);
+  PSUB(Y3, Y3, t);
+  PADD(Z3, Pp.Z, Q.Z); PMUL(Z3, Z3, Z3);
+  PSUB(Z3, Z3, Z1Z1); PSUB(Z3, Z3, Z2Z2); PMUL(Z3, Z3, H);
+  R.X = X3; R.Y = Y3; R.Z = Z3;
+}
+
+static void jac_madd(Jac& R, const Jac& Pp, const Fe& qx_m, const Fe& qy_m) {
+  // madd-2007-bl (Q affine, Z2 = 1): 7M + 4S
+  if (is_zero(Pp.Z)) { R.X = qx_m; R.Y = qy_m; R.Z = ONE_M; return; }
+  Fe Z1Z1, U2, S2, t;
+  PMUL(Z1Z1, Pp.Z, Pp.Z);
+  PMUL(U2, qx_m, Z1Z1);
+  PMUL(t, Pp.Z, Z1Z1); PMUL(S2, qy_m, t);
+  Fe H, Rr;
+  PSUB(H, U2, Pp.X); PSUB(Rr, S2, Pp.Y);
+  if (is_zero(H)) {
+    if (is_zero(Rr)) { jac_dbl(R, Pp); return; }
+    R.X = ONE_M; R.Y = ONE_M; R.Z = Fe{{0, 0, 0, 0}};
+    return;
+  }
+  Fe HH, I, J, V;
+  PMUL(HH, H, H);
+  PADD(I, HH, HH); PADD(I, I, I);  // I = 4*HH
+  PMUL(J, H, I);
+  PMUL(V, Pp.X, I);
+  PADD(Rr, Rr, Rr);  // r = 2*(S2-Y1)
+  Fe X3, Y3, Z3;
+  PMUL(X3, Rr, Rr); PSUB(X3, X3, J);
+  PSUB(X3, X3, V); PSUB(X3, X3, V);
+  PSUB(t, V, X3); PMUL(Y3, Rr, t);
+  PMUL(t, Pp.Y, J); PADD(t, t, t);
+  PSUB(Y3, Y3, t);
+  PADD(Z3, Pp.Z, H); PMUL(Z3, Z3, Z3);
+  PSUB(Z3, Z3, Z1Z1); PSUB(Z3, Z3, HH);
+  R.X = X3; R.Y = Y3; R.Z = Z3;
+}
+
+// Fixed 4-bit-window affine G table (Montgomery domain), built once per
+// process: GT[k] = (k+1)*G for k = 0..14.  Batch-normalized to affine
+// with ONE Fermat inversion (Montgomery's trick).
+static Fe GT_X[15], GT_Y[15];
+
+static void build_g_table() {
+  Jac pts[15];
+  pts[0] = {GX_M, GY_M, ONE_M};
+  for (int k = 1; k < 15; k++) jac_madd(pts[k], pts[k - 1], GX_M, GY_M);
+  // batch-invert the Z's
+  Fe prefix[15], acc = ONE_M;
+  for (int k = 0; k < 15; k++) { prefix[k] = acc; PMUL(acc, acc, pts[k].Z); }
+  Fe inv_acc, pm2, two = {{2, 0, 0, 0}};
+  sub_raw(pm2, P, two);
+  mont_pow(inv_acc, acc, pm2, P, P_INV, ONE_M);
+  for (int k = 14; k >= 0; k--) {
+    Fe zinv, z2, z3;
+    PMUL(zinv, inv_acc, prefix[k]);
+    PMUL(inv_acc, inv_acc, pts[k].Z);
+    PMUL(z2, zinv, zinv); PMUL(z3, z2, zinv);
+    PMUL(GT_X[k], pts[k].X, z2);
+    PMUL(GT_Y[k], pts[k].Y, z3);
+  }
+}
+
+#undef PMUL
+#undef PADD
+#undef PSUB
 
 }  // namespace p256
 
@@ -424,24 +519,37 @@ extern "C" int upow_p256_verify(const uint8_t* z_be, const uint8_t* r_be,
   mont_mul(u1, u1, one, N, N_INV);
   mont_mul(u2, u2, one, N, N_INV);
 
-  // ladder: R = u1*G + u2*Q with complete additions
-  Pt R = {{{0, 0, 0, 0}}, ONE_M, {{0, 0, 0, 0}}};
-  Pt G = {GX_M, GY_M, ONE_M};
-  Pt Q = {qx_m, qy_m, ONE_M};
-  for (int i = 255; i >= 0; i--) {
-    add_complete(R, R, R);
-    Pt t1;
-    add_complete(t1, R, G);
-    cmov(R, t1, (u1.v[i / 64] >> (i % 64)) & 1);
-    add_complete(t1, R, Q);
-    cmov(R, t1, (u2.v[i / 64] >> (i % 64)) & 1);
+  // Strauss double-scalar walk R = u1*G + u2*Q, 4-bit windows, MSB
+  // first: 252 doublings + at most 2 table adds per window (skipped on
+  // zero digits).  G adds are mixed (static affine table); the Q table
+  // is built per call.  ~3x fewer Montgomery muls than the earlier
+  // 256-step always-add complete ladder — verify-only code, so the
+  // data-dependent branches are fine.
+  {
+    static const bool g_ready = []() { build_g_table(); return true; }();
+    (void)g_ready;
+  }
+  Jac QT[15];
+  QT[0] = {qx_m, qy_m, ONE_M};
+  for (int k = 1; k < 15; k++) jac_madd(QT[k], QT[k - 1], qx_m, qy_m);
+
+  Jac R = {ONE_M, ONE_M, {{0, 0, 0, 0}}};  // infinity
+  for (int wi = 63; wi >= 0; wi--) {
+    if (wi != 63) {
+      jac_dbl(R, R); jac_dbl(R, R); jac_dbl(R, R); jac_dbl(R, R);
+    }
+    unsigned d1 = unsigned(u1.v[wi / 16] >> (4 * (wi % 16))) & 15u;
+    if (d1) jac_madd(R, R, GT_X[d1 - 1], GT_Y[d1 - 1]);
+    unsigned d2 = unsigned(u2.v[wi / 16] >> (4 * (wi % 16))) & 15u;
+    if (d2) jac_add(R, R, QT[d2 - 1]);
   }
   if (is_zero(R.Z)) return 0;
 
-  // accept iff X == r*Z or X == (r+n)*Z in the field (x mod n == r)
-  Fe r_pm, rz;
+  // accept iff X == r*Z^2 or X == (r+n)*Z^2 in the field (x mod n == r)
+  Fe z2, r_pm, rz;
+  mont_mul(z2, R.Z, R.Z, P, P_INV);
   mont_mul(r_pm, r, P_R2, P, P_INV);
-  mont_mul(rz, r_pm, R.Z, P, P_INV);
+  mont_mul(rz, r_pm, z2, P, P_INV);
   if (eq(R.X, rz)) return 1;
   // r + n < p case
   Fe rn;
@@ -454,7 +562,7 @@ extern "C" int upow_p256_verify(const uint8_t* z_be, const uint8_t* r_be,
   if (!carry && geq(P, rn) && !eq(P, rn)) {
     Fe rn_m;
     mont_mul(rn_m, rn, P_R2, P, P_INV);
-    mont_mul(rz, rn_m, R.Z, P, P_INV);
+    mont_mul(rz, rn_m, z2, P, P_INV);
     if (eq(R.X, rz)) return 1;
   }
   return 0;

@@ -813,11 +813,11 @@ class Node:
         info = await self._block_lookup(block)
         if not info:
             return web.json_response({"ok": False, "error": "Block not found"})
-        hashes = await self.state.get_block_transaction_hashes(info["hash"])
+        # the views helper drops reorg-raced Nones (never embed null)
+        txs = await self.state.get_block_nice_transactions(info["hash"])
         return web.json_response({"ok": True, "result": {
             "block": _json_block(info),
-            "transactions": [
-                await self.state.get_nice_transaction(h) for h in hashes],
+            "transactions": txs,
         }})
 
     async def h_get_blocks(self, request: web.Request) -> web.Response:

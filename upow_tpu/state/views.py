@@ -285,10 +285,13 @@ class StateViews:
     # ---------------------------------------------------- explorer views --
 
     async def get_block_nice_transactions(self, block_hash: str) -> List[dict]:
-        return [
+        # a tx can vanish between the hash listing and the per-tx lookup
+        # under a concurrent reorg: drop the None, never embed null
+        nice = [
             await self.get_nice_transaction(h)
             for h in await self.get_block_transaction_hashes(block_hash)
         ]
+        return [t for t in nice if t is not None]
 
     # ---------------------------------------------------------- emission --
 
