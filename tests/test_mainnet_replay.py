@@ -21,8 +21,13 @@ The whitelist/exception hashes are consensus data keyed by mainnet's
 content-addressed tx hashes, which a synthetic chain cannot reproduce —
 the double-spend whitelist and unstake-exception entries are therefore
 monkeypatched to this fixture's own hashes (the mainnet values themselves
-are differential-tested in test_core_consensus / test_chain).  The merkle
+are differential-tested in test_core_consensus / test_chain, and the
+whitelist LOGIC is A/B'd against the reference's check_block with the
+real mainnet outpoints in test_block_differential).  The merkle
 exception is driven with its REAL mainnet (height, root) pair.
+Complementary non-monkeypatched coverage: test_ref_stack_replay replays
+chains the reference stack itself built — real content-addressed
+hashes, no patched consensus data.
 
 Blocks are produced on a source chain via the mining path
 (``create_block``, which computes the rounding-switch-sensitive coinbase
