@@ -72,53 +72,79 @@ static void compress(uint32_t state[8], const uint8_t block[64]) {
 // SHA-NI compression (x86 SHA extensions): ~10x the portable loop on one
 // core.  Compiled with a per-function target attribute so the rest of the
 // library needs no -m flags; selected at runtime via cpuid.
-__attribute__((target("sha,sse4.1")))
-static void compress_shani(uint32_t state[8], const uint8_t block[64]) {
-  const __m128i MASK = _mm_set_epi64x(0x0c0d0e0f08090a0bULL,
-                                      0x0405060700010203ULL);
-  __m128i TMP    = _mm_loadu_si128((const __m128i*)&state[0]);
-  __m128i STATE1 = _mm_loadu_si128((const __m128i*)&state[4]);
-  TMP    = _mm_shuffle_epi32(TMP, 0xB1);             // CDAB
-  STATE1 = _mm_shuffle_epi32(STATE1, 0x1B);          // EFGH
-  __m128i STATE0 = _mm_alignr_epi8(TMP, STATE1, 8);  // ABEF
-  STATE1 = _mm_blend_epi16(STATE1, TMP, 0xF0);       // CDGH
-  const __m128i ABEF_SAVE = STATE0, CDGH_SAVE = STATE1;
-
-  __m128i msgs[4];
-  for (int i = 0; i < 4; i++)
-    msgs[i] = _mm_shuffle_epi8(
-        _mm_loadu_si128((const __m128i*)(block + 16 * i)), MASK);
-
-  for (int i = 0; i < 16; i++) {
-    __m128i wk = _mm_add_epi32(
-        msgs[i & 3], _mm_loadu_si128((const __m128i*)&K[4 * i]));
-    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, wk);
-    wk = _mm_shuffle_epi32(wk, 0x0E);
-    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, wk);
-    if (i < 12) {  // schedule W[4(i+4) ..] from W[4i ..]
-      __m128i tmp = _mm_alignr_epi8(msgs[(i + 3) & 3], msgs[(i + 2) & 3], 4);
-      msgs[i & 3] = _mm_sha256msg2_epu32(
-          _mm_add_epi32(_mm_sha256msg1_epu32(msgs[i & 3], msgs[(i + 1) & 3]),
-                        tmp),
-          msgs[(i + 3) & 3]);
-    }
-  }
-
-  STATE0 = _mm_add_epi32(STATE0, ABEF_SAVE);
-  STATE1 = _mm_add_epi32(STATE1, CDGH_SAVE);
-  TMP    = _mm_shuffle_epi32(STATE0, 0x1B);          // FEBA
-  STATE1 = _mm_shuffle_epi32(STATE1, 0xB1);          // DCHG
-  STATE0 = _mm_blend_epi16(TMP, STATE1, 0xF0);       // DCBA
-  STATE1 = _mm_alignr_epi8(STATE1, TMP, 8);          // HGFE
-  _mm_storeu_si128((__m128i*)&state[0], STATE0);
-  _mm_storeu_si128((__m128i*)&state[4], STATE1);
-}
-
 static bool shani_available() {
   __builtin_cpu_init();
   return __builtin_cpu_supports("sha") && __builtin_cpu_supports("sse4.1");
 }
+
+// LANES independent single-block compressions interleaved: sha256rnds2
+// has multi-cycle latency but ~1/cycle throughput, so a single hash
+// chain leaves the unit mostly idle.  Interleaving fills the pipeline —
+// the nonce search has unlimited independent work.  LANES = 4 measured
+// fastest here (measured 21.0 vs 20.4 at 2 and 20.2 at 8 lanes; ~1.3x one
+// stream on this virtualized core — bare-metal SHA-NI has more
+// pipeline headroom).
+template <int LANES>
+__attribute__((target("sha,sse4.1")))
+static void compress_shani_multi(uint32_t state[][8],
+                                 const uint8_t* const blocks[]) {
+  const __m128i MASK = _mm_set_epi64x(0x0c0d0e0f08090a0bULL,
+                                      0x0405060700010203ULL);
+  __m128i S0[LANES], S1[LANES], S0v[LANES], S1v[LANES], M[LANES][4];
+  for (int l = 0; l < LANES; l++) {
+    __m128i TMP = _mm_loadu_si128((const __m128i*)&state[l][0]);
+    __m128i ST1 = _mm_loadu_si128((const __m128i*)&state[l][4]);
+    TMP = _mm_shuffle_epi32(TMP, 0xB1);
+    ST1 = _mm_shuffle_epi32(ST1, 0x1B);
+    S0[l] = _mm_alignr_epi8(TMP, ST1, 8);
+    S1[l] = _mm_blend_epi16(ST1, TMP, 0xF0);
+    S0v[l] = S0[l]; S1v[l] = S1[l];
+    for (int i = 0; i < 4; i++)
+      M[l][i] = _mm_shuffle_epi8(
+          _mm_loadu_si128((const __m128i*)(blocks[l] + 16 * i)), MASK);
+  }
+  for (int i = 0; i < 16; i++) {
+    const __m128i k = _mm_loadu_si128((const __m128i*)&K[4 * i]);
+    for (int l = 0; l < LANES; l++) {
+      __m128i wk = _mm_add_epi32(M[l][i & 3], k);
+      S1[l] = _mm_sha256rnds2_epu32(S1[l], S0[l], wk);
+      wk = _mm_shuffle_epi32(wk, 0x0E);
+      S0[l] = _mm_sha256rnds2_epu32(S0[l], S1[l], wk);
+      if (i < 12) {
+        __m128i tmp = _mm_alignr_epi8(M[l][(i + 3) & 3], M[l][(i + 2) & 3], 4);
+        M[l][i & 3] = _mm_sha256msg2_epu32(
+            _mm_add_epi32(_mm_sha256msg1_epu32(M[l][i & 3], M[l][(i + 1) & 3]),
+                          tmp),
+            M[l][(i + 3) & 3]);
+      }
+    }
+  }
+  for (int l = 0; l < LANES; l++) {
+    S0[l] = _mm_add_epi32(S0[l], S0v[l]);
+    S1[l] = _mm_add_epi32(S1[l], S1v[l]);
+    __m128i TMP = _mm_shuffle_epi32(S0[l], 0x1B);
+    S1[l] = _mm_shuffle_epi32(S1[l], 0xB1);
+    S0[l] = _mm_blend_epi16(TMP, S1[l], 0xF0);
+    S1[l] = _mm_alignr_epi8(S1[l], TMP, 8);
+    _mm_storeu_si128((__m128i*)&state[l][0], S0[l]);
+    _mm_storeu_si128((__m128i*)&state[l][4], S1[l]);
+  }
+}
+
+// single-stream form (digest(), sequential callers): the 1-lane
+// instantiation of the same transcription — one copy to keep correct
+__attribute__((target("sha,sse4.1")))
+static void compress_shani(uint32_t state[8], const uint8_t block[64]) {
+  compress_shani_multi<1>((uint32_t(*)[8])state, &block);
+}
 #else
+template <int LANES>
+static void compress_shani_multi(uint32_t state[][8],
+                                 const uint8_t* const blocks[]) {
+  for (int l = 0; l < LANES; l++) compress(state[l], blocks[l]);
+}
+#endif
+#if !(defined(__x86_64__) && defined(__GNUC__))
 static void compress_shani(uint32_t state[8], const uint8_t block[64]) {
   compress(state, block);
 }
@@ -186,16 +212,7 @@ extern "C" uint32_t upow_pow_search(const uint8_t* prefix, size_t prefix_len,
   uint64_t bits = uint64_t(total) * 8;
   for (int i = 0; i < 8; i++) tail[63 - i] = uint8_t(bits >> (8 * i));
 
-  uint8_t blk[64];
-  memcpy(blk, tail, 64);  // only the 4 nonce bytes change per iteration
-  for (uint64_t n = start; n < uint64_t(start) + count; n++) {
-    uint32_t state[8];
-    memcpy(state, mid, sizeof(mid));
-    blk[rem] = uint8_t(n);
-    blk[rem + 1] = uint8_t(n >> 8);
-    blk[rem + 2] = uint8_t(n >> 16);
-    blk[rem + 3] = uint8_t(n >> 24);
-    compress(state, blk);
+  auto hit = [&](const uint32_t state[8]) -> bool {
     bool ok = true;
     for (size_t i = 0; i < n_target && ok; i++) {
       uint32_t nib = (state[i / 8] >> (28 - 4 * (i % 8))) & 0xF;
@@ -205,7 +222,50 @@ extern "C" uint32_t upow_pow_search(const uint8_t* prefix, size_t prefix_len,
       uint32_t nib = (state[n_target / 8] >> (28 - 4 * (n_target % 8))) & 0xF;
       ok = nib < charset;
     }
-    if (ok) return uint32_t(n);
+    return ok;
+  };
+
+  const uint64_t end = uint64_t(start) + count;
+  uint64_t n = start;
+
+  if (sha256::shani_available()) {
+    // 4-way interleaved SHA-NI: ~1.3x one stream here (pipeline-bound, not
+    // throughput-bound).  Returns the LOWEST hit in the quad — same
+    // first-hit semantics as the scalar loop.
+    constexpr int LANES = 4;
+    uint8_t blks[LANES][64];
+    uint32_t states[LANES][8];
+    const uint8_t* blk_ptrs[LANES];
+    for (int l = 0; l < LANES; l++) {
+      memcpy(blks[l], tail, 64);
+      blk_ptrs[l] = blks[l];
+    }
+    for (; n + LANES <= end; n += LANES) {
+      for (int l = 0; l < LANES; l++) {
+        uint64_t nl = n + l;
+        memcpy(states[l], mid, sizeof(mid));
+        blks[l][rem] = uint8_t(nl);
+        blks[l][rem + 1] = uint8_t(nl >> 8);
+        blks[l][rem + 2] = uint8_t(nl >> 16);
+        blks[l][rem + 3] = uint8_t(nl >> 24);
+      }
+      sha256::compress_shani_multi<LANES>(states, blk_ptrs);
+      for (int l = 0; l < LANES; l++)
+        if (hit(states[l])) return uint32_t(n + l);
+    }
+  }
+
+  uint8_t blk[64];
+  memcpy(blk, tail, 64);  // only the 4 nonce bytes change per iteration
+  for (; n < end; n++) {
+    uint32_t state[8];
+    memcpy(state, mid, sizeof(mid));
+    blk[rem] = uint8_t(n);
+    blk[rem + 1] = uint8_t(n >> 8);
+    blk[rem + 2] = uint8_t(n >> 16);
+    blk[rem + 3] = uint8_t(n >> 24);
+    compress(state, blk);
+    if (hit(state)) return uint32_t(n);
   }
   return 0xFFFFFFFFu;
 }
