@@ -285,13 +285,12 @@ def config5_sharded(seconds: float):
     _emit(f"mine_d8_sharded_{n_dev}x_{_platform()}", rate, "MH/s", base_rate)
 
 
-def config6_block8k(seconds: float):
-    """Full 8k-tx block accept, end to end through BlockManager: header +
-    PoW checks, per-tx rules, ONE batched signature dispatch, batched
-    UTXO double-spend set-diffs, and all state writes.  This is the
-    README design point the reference never demonstrates (~8,300 tx per
-    2 MB block, README.md:26-28; its accept path verifies signatures
-    serially per input, transaction_input.py:100-109)."""
+async def _chain_with_utxo_fanout(n_fan: int, n_per: int, rng_key: int):
+    """3-block chain fanning one coinbase into n_fan x n_per spendable
+    leaf outputs (shared scaffolding for the accept/intake configs).
+    Returns (state, manager, keys..., mids, mine_block) where
+    ``mine_block(txs)`` accepts one more block and returns its accept
+    seconds."""
     from decimal import Decimal
 
     from upow_tpu.core import clock, curve, difficulty, point_to_string
@@ -304,65 +303,83 @@ def config6_block8k(seconds: float):
 
     difficulty.START_DIFFICULTY = Decimal("1.0")
     GENESIS_PREV = (18_884_643).to_bytes(32, "little").hex()
-    N_FAN, N_PER = 255, 32          # 255 x 32 = 8160 spendable outputs
+
+    state = ChainState()
+    manager = BlockManager(state)
+    d, pub = curve.keygen(rng=rng_key)
+    addr = point_to_string(pub)
+    pub_of = lambda _i: pub
+
+    async def mine_block(txs):
+        clock.advance(60)
+        diff, last = await manager.calculate_difficulty()
+        prev = last["hash"] if last else GENESIS_PREV
+        header = BlockHeader(
+            previous_hash=prev, address=addr, merkle_root=merkle_root(txs),
+            timestamp=clock.timestamp(), difficulty_x10=int(diff * 10),
+            nonce=0)
+        if last:
+            r = mine(MiningJob(header.prefix_bytes(), prev, diff),
+                     "python", batch=1 << 14, ttl=600)
+            header.nonce = r.nonce
+        errors = []
+        t0 = time.perf_counter()
+        ok = await manager.create_block(header.hex(), txs, errors=errors)
+        dt = time.perf_counter() - t0
+        assert ok, errors
+        return dt
+
+    await mine_block([])                      # block 1: coinbase to addr
+    coin = (await state.get_spendable_outputs(addr))[0]
+    reward = coin.amount
+
+    per = reward // n_fan
+    outs = [TxOutput(addr, per)] * (n_fan - 1)
+    outs = outs + [TxOutput(addr, reward - per * (n_fan - 1))]
+    fan = Tx([coin], outs).sign([d], pub_of)
+    await mine_block([fan])
+
+    mids = []
+    for j in range(n_fan):
+        amt = fan.outputs[j].amount
+        sub = amt // n_per
+        souts = [TxOutput(addr, sub)] * (n_per - 1)
+        souts = souts + [TxOutput(addr, amt - sub * (n_per - 1))]
+        mids.append(Tx([TxInput(fan.hash(), j)], souts).sign([d], pub_of))
+    await mine_block(mids)
+    return state, manager, d, pub, addr, mids, mine_block
+
+
+def _leaf_spends(parents, addr, d, pub):
+    from upow_tpu.core.tx import Tx, TxInput, TxOutput
+
+    out = []
+    for m in parents:
+        h = m.hash()
+        for k, o in enumerate(m.outputs):
+            out.append(Tx([TxInput(h, k)], [TxOutput(addr, o.amount)])
+                       .sign([d], lambda _i: pub))
+    return out
+
+
+def config6_block8k(seconds: float):
+    """Full 8k-tx block accept, end to end through BlockManager: header +
+    PoW checks, per-tx rules, ONE batched signature dispatch, batched
+    UTXO double-spend set-diffs, and all state writes.  This is the
+    README design point the reference never demonstrates (~8,300 tx per
+    2 MB block, README.md:26-28; its accept path verifies signatures
+    serially per input, transaction_input.py:100-109)."""
+    from upow_tpu.core import curve
 
     async def scenario():
-        state = ChainState()
-        manager = BlockManager(state)
-        d, pub = curve.keygen(rng=0xB10C)
-        addr = point_to_string(pub)
-        pub_of = lambda _i: pub
-
-        async def mine_block(txs):
-            clock.advance(60)
-            diff, last = await manager.calculate_difficulty()
-            prev = last["hash"] if last else GENESIS_PREV
-            header = BlockHeader(
-                previous_hash=prev, address=addr, merkle_root=merkle_root(txs),
-                timestamp=clock.timestamp(), difficulty_x10=int(diff * 10),
-                nonce=0)
-            if last:
-                r = mine(MiningJob(header.prefix_bytes(), prev, diff),
-                         "python", batch=1 << 14, ttl=600)
-                header.nonce = r.nonce
-            errors = []
-            t0 = time.perf_counter()
-            ok = await manager.create_block(header.hex(), txs, errors=errors)
-            dt = time.perf_counter() - t0
-            assert ok, errors
-            return dt
-
-        await mine_block([])                      # block 1: coinbase to addr
-        coin = (await state.get_spendable_outputs(addr))[0]
-        reward = coin.amount
-
-        # block 2: one fan-out tx -> 255 outputs
-        per = reward // N_FAN
-        outs = [TxOutput(addr, per)] * (N_FAN - 1)
-        outs = outs + [TxOutput(addr, reward - per * (N_FAN - 1))]
-        fan = Tx([coin], outs).sign([d], pub_of)
-        await mine_block([fan])
-
-        # block 3: 255 txs x 32 outputs = 8160 leaf UTXOs
-        mids = []
-        for j in range(N_FAN):
-            amt = fan.outputs[j].amount
-            sub = amt // N_PER
-            souts = [TxOutput(addr, sub)] * (N_PER - 1)
-            souts = souts + [TxOutput(addr, amt - sub * (N_PER - 1))]
-            mids.append(Tx([TxInput(fan.hash(), j)], souts).sign([d], pub_of))
-        await mine_block(mids)
+        # 255 x 32 = 8160 spendable leaf outputs
+        state, manager, d, pub, addr, mids, mine_block = \
+            await _chain_with_utxo_fanout(255, 32, 0xB10C)
 
         # block 4 (measured, cold): 8160 txs, each 1-in-1-out, signatures
         # never seen before — the worst-case accept
         def leaf_spends(parents):
-            out = []
-            for m in parents:
-                h = m.hash()
-                for k, o in enumerate(m.outputs):
-                    out.append(Tx([TxInput(h, k)], [TxOutput(addr, o.amount)])
-                               .sign([d], pub_of))
-            return out
+            return _leaf_spends(parents, addr, d, pub)
 
         leaves = leaf_spends(mids)
         dt_cold = await mine_block(leaves)
@@ -407,6 +424,81 @@ def config6_block8k(seconds: float):
     clock.reset()
     _emit(f"block_accept_8k_{_platform()}", rate_cold, "tx/s", base_rate)
     _emit(f"block_accept_8k_warm_{_platform()}", rate_warm, "tx/s", base_rate)
+
+
+def config8_intake(seconds: float):
+    """push_tx intake over real localhost HTTP: JSON parse + wire parse
+    + rules + signature verify (native C++ on the host path) + pending
+    insert + gossip spawn, one round trip per tx — the reference's
+    per-tx gossip ingest cost (main.py:267-323)."""
+    import tempfile
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from upow_tpu.config import Config
+    from upow_tpu.core import clock, curve
+    from upow_tpu.node.app import Node
+
+    N_TX = 2048  # fan a coinbase into this many spendable outputs
+
+    async def scenario():
+        # 10 x 224 = 2240 leaf outputs (<=255 per tx)
+        state, manager, d, pub, addr, mids, _mine = \
+            await _chain_with_utxo_fanout(10, 224, 0x17A4)
+        txs = _leaf_spends(mids, addr, d, pub)
+        assert len(txs) >= N_TX
+        payloads = [t.hex() for t in txs[:N_TX]]
+
+        cfg = Config()
+        with tempfile.TemporaryDirectory() as tmp:
+            cfg.node.db_path = ""
+            cfg.node.seed_url = ""
+            cfg.node.peers_file = f"{tmp}/nodes.json"
+            cfg.node.ip_config_file = ""
+            cfg.log.path = ""
+            cfg.log.console = False
+            node = Node(cfg, state=state)
+            server = TestServer(node.app)
+            await server.start_server()
+            client = TestClient(server)
+            node.started = True
+            node.rate_limiter.enabled = False  # measuring us, not limits
+            try:
+                # warm one request (route setup, first-parse imports) —
+                # outside the timed window AND the numerator
+                r = await (await client.post(
+                    "/push_tx", json={"tx_hex": payloads[0]})).json()
+                assert r.get("ok"), r
+                t0 = time.perf_counter()
+                done = 0
+                for p in payloads[1:]:
+                    r = await (await client.post(
+                        "/push_tx", json={"tx_hex": p})).json()
+                    assert r.get("ok"), r
+                    done += 1
+                    if time.perf_counter() - t0 > seconds:
+                        break
+                elapsed = time.perf_counter() - t0
+            finally:
+                await client.close()
+                await server.close()
+                await node.close()
+        return done / elapsed
+
+    # baseline: serial pure-python verify, one per tx (the dominant
+    # reference-side cost of intake)
+    dd, bpub = curve.keygen(rng=0xBA5E)
+    sig = curve.sign(b"base", dd)
+    t0 = time.perf_counter()
+    n_base = 0
+    while time.perf_counter() - t0 < 1.0:
+        curve.verify(sig, b"base", bpub)
+        n_base += 1
+    base_rate = n_base / (time.perf_counter() - t0)
+
+    rate = asyncio.run(scenario())
+    clock.reset()
+    _emit(f"push_tx_intake_{_platform()}", rate, "tx/s", base_rate)
 
 
 def config7_txid_batch(seconds: float):
@@ -459,6 +551,7 @@ def main() -> int:
         "5": lambda: config5_sharded(args.seconds),
         "6": lambda: config6_block8k(args.seconds),
         "7": lambda: config7_txid_batch(args.seconds),
+        "8": lambda: config8_intake(args.seconds),
     }
     needs_device = {"2", "3", "5", "7"}
     for key in args.configs.split(","):

@@ -630,10 +630,21 @@ class PgChainState(StateViews):
                 out.append(rows[0]["tx_hex"])
         return out
 
-    async def get_pending_spent_outpoints(self) -> set:
+    async def get_pending_spent_outpoints(self, outpoints=None) -> set:
+        """Pending-spent overlay; ``outpoints`` narrows the fetch to one
+        tx's inputs (see the sqlite twin's rationale — full scans per
+        intake tx are quadratic in mempool depth)."""
+        if outpoints is None:
+            rows = await self.drv.afetch(
+                'SELECT tx_hash, "index" FROM pending_spent_outputs')
+            return {(r["tx_hash"], r["index"]) for r in rows}
+        want = {tuple(o) for o in outpoints}
+        if not want:
+            return set()
         rows = await self.drv.afetch(
-            'SELECT tx_hash, "index" FROM pending_spent_outputs')
-        return {(r["tx_hash"], r["index"]) for r in rows}
+            'SELECT tx_hash, "index" FROM pending_spent_outputs'
+            " WHERE tx_hash = ANY($1)", (list({h for h, _ in want}),))
+        return {(r["tx_hash"], r["index"]) for r in rows} & want
 
     async def remove_pending_transactions_by_hash(self,
                                                   hashes: List[str]) -> None:

@@ -445,8 +445,11 @@ class TxVerifier:
         return all(present)
 
     async def no_pending_double_spend(self, tx: Tx) -> bool:
-        """Inputs absent from the pending-spent overlay (transaction.py:126-133)."""
-        pending = await self.state.get_pending_spent_outpoints()
+        """Inputs absent from the pending-spent overlay
+        (transaction.py:126-133; like the reference, only this tx's
+        outpoints are fetched — not the whole overlay)."""
+        pending = await self.state.get_pending_spent_outpoints(
+            [i.outpoint for i in tx.inputs])
         return all(i.outpoint not in pending for i in tx.inputs)
 
     # -- DPoS rules (each returns True when the rule does not apply) -------
