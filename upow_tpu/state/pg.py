@@ -805,6 +805,24 @@ class PgChainState(StateViews):
             })
         return out
 
+    async def _pending_filter(self, rows, check_pending_txs: bool) -> set:
+        """Pending-spent overlay narrowed to these rows' outpoints (see
+        the sqlite twin — full scans per lookup are quadratic under
+        mempool load)."""
+        if not check_pending_txs:
+            return set()
+        # threshold: narrowing wins when the row set is small (intake,
+        # per-address lookups); full-table views (registrations,
+        # ballots) would ship one bind param per row and invert the
+        # cost model — there the one O(overlay) fetch stays cheaper,
+        # and the cap also bounds the IN-clause parameter count
+        if not rows:
+            return set()
+        if len(rows) > 256:
+            return await self.get_pending_spent_outpoints()
+        return await self.get_pending_spent_outpoints(
+            [(r["tx_hash"], r["index"]) for r in rows])
+
     async def get_spendable_outputs(self, address: str,
                                     check_pending_txs: bool = False) -> List[TxInput]:
         rows = await self.drv.afetch(
@@ -812,8 +830,7 @@ class PgChainState(StateViews):
             " t.outputs_amounts FROM unspent_outputs u"
             " JOIN transactions t ON t.tx_hash = u.tx_hash"
             " WHERE u.address = $1 AND u.is_stake = $2", (address, False))
-        pending = (await self.get_pending_spent_outpoints()) \
-            if check_pending_txs else set()
+        pending = await self._pending_filter(rows, check_pending_txs)
         out = []
         for r in await self._amounts_for(rows):
             if (r["tx_hash"], r["index"]) in pending:
@@ -830,8 +847,7 @@ class PgChainState(StateViews):
             " t.outputs_amounts FROM unspent_outputs u"
             " JOIN transactions t ON t.tx_hash = u.tx_hash"
             " WHERE u.address = $1 AND u.is_stake = $2", (address, True))
-        pending = (await self.get_pending_spent_outpoints()) \
-            if check_pending_txs else set()
+        pending = await self._pending_filter(rows, check_pending_txs)
         out = []
         for r in await self._amounts_for(rows):
             if (r["tx_hash"], r["index"]) in pending:
@@ -865,8 +881,7 @@ class PgChainState(StateViews):
             " LEFT JOIN transactions t ON t.tx_hash = g.tx_hash"
             " LEFT JOIN blocks b ON b.hash = t.block_hash")
         if pending is None:
-            pending = (await self.get_pending_spent_outpoints()) \
-                if check_pending_txs else set()
+            pending = await self._pending_filter(rows, check_pending_txs)
         out = []
         for r in rows:
             if (r["tx_hash"], r["index"]) in pending:
@@ -884,8 +899,7 @@ class PgChainState(StateViews):
             f" t.inputs_addresses FROM {table} g"
             f" JOIN transactions t ON t.tx_hash = g.tx_hash"
             f" WHERE g.address = $1", (recipient,))
-        pending = (await self.get_pending_spent_outpoints()) \
-            if check_pending_txs else set()
+        pending = await self._pending_filter(rows, check_pending_txs)
         out = []
         for r in rows:
             if (r["tx_hash"], r["index"]) in pending:
@@ -909,8 +923,7 @@ class PgChainState(StateViews):
             f" t.outputs_amounts, t.inputs_addresses FROM {table} g"
             f" JOIN transactions t ON t.tx_hash = g.tx_hash")
         if pending is None:
-            pending = (await self.get_pending_spent_outpoints()) \
-                if check_pending_txs else set()
+            pending = await self._pending_filter(rows, check_pending_txs)
         out = []
         for r in rows:
             if (r["tx_hash"], r["index"]) in pending:
@@ -932,8 +945,7 @@ class PgChainState(StateViews):
         rows = await self.drv.afetch(
             f'SELECT tx_hash, "index" FROM {table} WHERE address = $1',
             (address,))
-        pending = (await self.get_pending_spent_outpoints()) \
-            if check_pending_txs else set()
+        pending = await self._pending_filter(rows, check_pending_txs)
         return [(r["tx_hash"], r["index"]) for r in rows
                 if (r["tx_hash"], r["index"]) not in pending]
 
@@ -970,8 +982,7 @@ class PgChainState(StateViews):
             f" AND u.address IN ({placeholders})",
             list(addresses) + [True])
         if pending is None:
-            pending = (await self.get_pending_spent_outpoints()) \
-                if check_pending_txs else set()
+            pending = await self._pending_filter(rows, check_pending_txs)
         for r in await self._amounts_for(rows):
             if (r["tx_hash"], r["index"]) in pending:
                 continue
@@ -996,8 +1007,7 @@ class PgChainState(StateViews):
             sql += " AND g.is_stake = $2"
             params.append(bool(is_stake))
         rows = await self.drv.afetch(sql, params)
-        pending = (await self.get_pending_spent_outpoints()) \
-            if check_pending_txs else set()
+        pending = await self._pending_filter(rows, check_pending_txs)
         return [
             {"tx_hash": r["tx_hash"], "index": r["index"],
              "amount": r["amount"]}

@@ -826,6 +826,23 @@ class ChainState(StateViews):
 
     # ------------------------------------------------------ address views --
 
+    async def _pending_filter(self, rows, check_pending_txs: bool) -> set:
+        """Pending-spent overlay narrowed to these rows' outpoints (the
+        full-overlay scan per lookup was quadratic under mempool load)."""
+        if not check_pending_txs:
+            return set()
+        # threshold: narrowing wins when the row set is small (intake,
+        # per-address lookups); full-table views (registrations,
+        # ballots) would ship one bind param per row and invert the
+        # cost model — there the one O(overlay) fetch stays cheaper,
+        # and the cap also bounds the IN-clause parameter count
+        if not rows:
+            return set()
+        if len(rows) > 256:
+            return await self.get_pending_spent_outpoints()
+        return await self.get_pending_spent_outpoints(
+            [(r["tx_hash"], r["idx"]) for r in rows])
+
     async def get_spendable_outputs(self, address: str,
                                     check_pending_txs: bool = False) -> List[TxInput]:
         """REGULAR/UN_STAKE outputs owned by the address, minus anything in
@@ -834,7 +851,7 @@ class ChainState(StateViews):
             "SELECT tx_hash, idx, amount, is_stake FROM unspent_outputs"
             " WHERE address = ? AND is_stake = 0", (address,),
         ).fetchall()
-        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
+        pending = await self._pending_filter(rows, check_pending_txs)
         out = []
         for r in rows:
             if (r["tx_hash"], r["idx"]) in pending:
@@ -850,7 +867,7 @@ class ChainState(StateViews):
             "SELECT tx_hash, idx, amount FROM unspent_outputs"
             " WHERE address = ? AND is_stake = 1", (address,),
         ).fetchall()
-        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
+        pending = await self._pending_filter(rows, check_pending_txs)
         out = []
         for r in rows:
             if (r["tx_hash"], r["idx"]) in pending:
@@ -880,8 +897,7 @@ class ChainState(StateViews):
         rows = self.db.execute(
             f"SELECT g.tx_hash, g.idx, g.address FROM {table} g").fetchall()
         if pending is None:
-            pending = (await self.get_pending_spent_outpoints()) \
-                if check_pending_txs else set()
+            pending = await self._pending_filter(rows, check_pending_txs)
         out = []
         for r in rows:
             if (r["tx_hash"], r["idx"]) in pending:
@@ -908,7 +924,7 @@ class ChainState(StateViews):
             f"SELECT g.tx_hash, g.idx, g.amount FROM {table} g WHERE g.address = ?",
             (recipient,),
         ).fetchall()
-        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
+        pending = await self._pending_filter(rows, check_pending_txs)
         out = []
         for r in rows:
             if (r["tx_hash"], r["idx"]) in pending:
@@ -936,8 +952,7 @@ class ChainState(StateViews):
             f" JOIN transactions t ON t.tx_hash = g.tx_hash"
         ).fetchall()
         if pending is None:
-            pending = (await self.get_pending_spent_outpoints()) \
-                if check_pending_txs else set()
+            pending = await self._pending_filter(rows, check_pending_txs)
         out = []
         for r in rows:
             if (r["tx_hash"], r["idx"]) in pending:
@@ -964,7 +979,7 @@ class ChainState(StateViews):
             "SELECT tx_hash, idx FROM delegates_voting_power WHERE address = ?",
             (address,),
         ).fetchall()
-        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
+        pending = await self._pending_filter(rows, check_pending_txs)
         return [(r["tx_hash"], r["idx"]) for r in rows
                 if (r["tx_hash"], r["idx"]) not in pending]
 
@@ -974,7 +989,7 @@ class ChainState(StateViews):
             "SELECT tx_hash, idx FROM inode_registration_output WHERE address = ?",
             (address,),
         ).fetchall()
-        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
+        pending = await self._pending_filter(rows, check_pending_txs)
         return [(r["tx_hash"], r["idx"]) for r in rows
                 if (r["tx_hash"], r["idx"]) not in pending]
 
@@ -985,7 +1000,7 @@ class ChainState(StateViews):
             "SELECT tx_hash, idx FROM validators_voting_power WHERE address = ?",
             (address,),
         ).fetchall()
-        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
+        pending = await self._pending_filter(rows, check_pending_txs)
         return [(r["tx_hash"], r["idx"]) for r in rows
                 if (r["tx_hash"], r["idx"]) not in pending]
 
@@ -1005,8 +1020,7 @@ class ChainState(StateViews):
             f" WHERE is_stake = 1 AND address IN ({placeholders})", addresses,
         ).fetchall()
         if pending is None:
-            pending = (await self.get_pending_spent_outpoints()) \
-                if check_pending_txs else set()
+            pending = await self._pending_filter(rows, check_pending_txs)
         for r in rows:
             if (r["tx_hash"], r["idx"]) in pending:
                 continue
@@ -1030,7 +1044,7 @@ class ChainState(StateViews):
             sql += " AND is_stake = ?"
             params.append(int(is_stake))
         rows = self.db.execute(sql, params).fetchall()
-        pending = (await self.get_pending_spent_outpoints()) if check_pending_txs else set()
+        pending = await self._pending_filter(rows, check_pending_txs)
         return [
             {"tx_hash": r["tx_hash"], "index": r["idx"], "amount": r["amount"]}
             for r in rows if (r["tx_hash"], r["idx"]) not in pending
