@@ -1272,21 +1272,159 @@ def test_mining_info_ten_tx_template(tmp_path, keys):
     run_cluster(tmp_path, scenario)
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _boot_node_process(cfg_path, port, log_path):
+    """Launch `node.run --config` as a real child and poll until the API
+    answers.  On death or timeout: kill the child and raise with the
+    log tail (an orphan would hold the port and db for the whole run)."""
+    import json as _json
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    with open(log_path, "wb") as sink:  # child owns its fd copy
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "upow_tpu.node.run", "--config",
+             str(cfg_path)], stdout=sink, stderr=subprocess.STDOUT)
+    deadline = time.time() + 60
+    last_err = None
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                "node died on boot: "
+                + log_path.read_bytes().decode(errors="replace")[-2000:])
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/get_mining_info",
+                    timeout=2) as resp:
+                _json.loads(resp.read())
+            return proc
+        except Exception as e:  # noqa: BLE001 - retry until deadline
+            last_err = e
+            time.sleep(0.5)
+    proc.kill()
+    raise AssertionError(
+        f"node never came up ({last_err}): "
+        + log_path.read_bytes().decode(errors="replace")[-2000:])
+
+
+def test_node_survives_sigkill_and_resumes(tmp_path, keys):
+    """Crash durability (SURVEY §5 checkpoint/resume): a file-backed
+    node is SIGKILLed — no shutdown hooks, no flush — restarted on the
+    same database, and must come back with the identical chain head AND
+    UTXO fingerprint (both via the HTTP surface) and keep accepting
+    blocks.  sqlite WAL plus the single-transaction accept make every
+    accepted block durable the moment push_block returns ok."""
+    import json as _json
+    import signal
+    import subprocess
+    import time
+    import urllib.request
+
+    from decimal import Decimal
+
+    from upow_tpu.core.header import BlockHeader
+    from upow_tpu.core.merkle import miner_merkle_root
+    from upow_tpu.mine.engine import MiningJob, mine as engine_mine
+
+    port = _free_port()
+    cfg = {
+        "node": {
+            "port": port,
+            "db_path": str(tmp_path / "durable.db"),
+            "seed_url": "",
+            "peers_file": str(tmp_path / "nodes.json"),
+            "ip_config_file": "",
+        },
+        "device": {"sig_backend": "host"},
+        "log": {"path": "", "console": False},
+    }
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(_json.dumps(cfg))
+
+    def http(path, data=None):
+        url = f"http://127.0.0.1:{port}{path}"
+        req = urllib.request.Request(
+            url, data=_json.dumps(data).encode() if data else None,
+            headers={"Content-Type": "application/json"} if data else {})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return _json.loads(resp.read())
+
+    def boot(log_name):
+        return _boot_node_process(cfg_path, port, tmp_path / log_name)
+
+    last_ts = [0]
+
+    def mine_one():
+        while int(time.time()) <= last_ts[0]:
+            time.sleep(0.2)
+        mi = http("/get_mining_info")["result"]
+        last = dict(mi["last_block"])
+        prev = last.get("hash", GENESIS_PREV_HASH)
+        ts = int(time.time())
+        last_ts[0] = ts
+        header = BlockHeader(
+            previous_hash=prev, address=keys["addr"],
+            merkle_root=miner_merkle_root([]), timestamp=ts,
+            difficulty_x10=int(Decimal(str(mi["difficulty"])) * 10),
+            nonce=0)
+        if last.get("hash"):
+            job = MiningJob(header.prefix_bytes(), prev,
+                            Decimal(str(mi["difficulty"])))
+            r = engine_mine(job, "native", batch=1 << 22, ttl=120)
+            assert r.nonce is not None
+            header.nonce = r.nonce
+        out = http("/push_block", {
+            "block_content": header.hex(), "txs": [],
+            "block_no": last.get("id", 0) + 1})
+        assert out["ok"], out
+
+    proc = boot("node1.log")
+    try:
+        for _ in range(3):
+            mine_one()
+        head_before = http("/get_mining_info")["result"]["last_block"]
+        fp_before = http("/")["unspent_outputs_hash"]
+        assert head_before["id"] == 3
+    finally:
+        proc.send_signal(signal.SIGKILL)  # crash, not shutdown
+        proc.wait(timeout=10)
+
+    proc = boot("node2.log")
+    try:
+        head_after = http("/get_mining_info")["result"]["last_block"]
+        assert head_after["hash"] == head_before["hash"], \
+            (head_before, head_after)
+        # the UTXO set survived the crash byte-identically
+        assert http("/")["unspent_outputs_hash"] == fp_before
+        mine_one()  # the resumed node keeps accepting
+        assert http("/get_mining_info")["result"]["last_block"]["id"] == 4
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 def test_launcher_boots_from_config_alone(tmp_path):
     """`python -m upow_tpu.node.run --config cfg.json` in a real child
     process: the node must come up from config alone (SURVEY §5 config
     axis), serve the API, and shut down cleanly on SIGTERM."""
     import json as _json
     import signal
-    import socket
     import subprocess
-    import sys
-    import time
     import urllib.request
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    port = _free_port()
     cfg = {
         "node": {
             "port": port,
@@ -1301,33 +1439,12 @@ def test_launcher_boots_from_config_alone(tmp_path):
     cfg_path = tmp_path / "cfg.json"
     cfg_path.write_text(_json.dumps(cfg))
 
-    # child output goes to a file, not pipes — an undrained pipe can
-    # block the child (and proc.wait) once the ~64 KiB buffer fills
-    child_log = tmp_path / "child.log"
-    with open(child_log, "wb") as sink:
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "upow_tpu.node.run", "--config",
-             str(cfg_path)],
-            stdout=sink, stderr=subprocess.STDOUT)
+    proc = _boot_node_process(cfg_path, port, tmp_path / "child.log")
     try:
-        deadline = time.time() + 60
-        last_err = None
-        while time.time() < deadline:
-            if proc.poll() is not None:
-                raise AssertionError(
-                    "launcher died: "
-                    + child_log.read_bytes().decode(errors="replace")[-2000:])
-            try:
-                with urllib.request.urlopen(
-                        f"http://127.0.0.1:{port}/get_mining_info",
-                        timeout=2) as resp:
-                    body = _json.loads(resp.read())
-                break
-            except Exception as e:  # noqa: BLE001 - retry until deadline
-                last_err = e
-                time.sleep(0.5)
-        else:
-            raise AssertionError(f"node never came up: {last_err}")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/get_mining_info",
+                timeout=10) as resp:
+            body = _json.loads(resp.read())
         assert body["ok"] and "difficulty" in body["result"]
         # the rotating-file logger wrote where config said
         assert (tmp_path / "app.log").exists()
