@@ -18,8 +18,11 @@ power) transaction signed through the reference's signing path.
 
 import asyncio
 import hashlib
+import os
 import time
 from decimal import Decimal
+
+import pytest
 
 from ref_loader import load_reference
 
@@ -63,7 +66,10 @@ class RefDbAdapter:
         return b
 
     async def get_block_by_id(self, block_id):
-        return await self.state.get_block_by_id(block_id)
+        # the reference computes the retarget-window start as
+        # id - BLOCKS_COUNT + 1 with its Decimal BLOCKS_COUNT
+        # (manager.py:95-97) — coerce for the sqlite binding
+        return await self.state.get_block_by_id(int(block_id))
 
     async def get_genesis_block(self):
         g = await self.state.get_block_by_id(1)
@@ -300,6 +306,153 @@ def test_reference_built_chain_replays_through_our_sync(tmp_path):
         asyncio.run(build_chain())
         asyncio.run(_replay_into_fresh_node(
             tmp_path, builder_state, 6, "replay", extra_checks))
+    finally:
+        ref_db_mod.Database.instance = None
+        builder_state.close()
+
+
+@pytest.mark.skipif(not os.environ.get("UPOW_SLOW_TESTS"),
+                    reason="194 mined blocks, ~2.5 min (UPOW_SLOW_TESTS=1)")
+def test_reference_built_inode_lifecycle_replays(tmp_path):
+    """The inode half of governance through the reference stack: fund
+    1000 coins (167 coinbases consolidated under the 255-input cap),
+    stake, inode registration, a validator voting FOR the inode
+    (vote-as-validator), the 48 h revoke of that vote, and inode
+    de-registration — all built by the reference's create_block over
+    our storage, replayed through our sync."""
+    load_reference()
+    import upow.database as ref_db_mod
+    import upow.manager as ref_manager
+    from upow.upow_transactions import (Transaction, TransactionInput,
+                                        TransactionOutput)
+    from upow.helpers import OutputType as RefOT
+
+    d_g, pub_g = curve.keygen(rng=0x140D)
+    addr_g = point_to_string(pub_g)  # miner, delegate, validator
+    d_i, pub_i = curve.keygen(rng=0x140E)
+    addr_i = point_to_string(pub_i)  # becomes the inode
+
+    builder_state = ChainState(str(tmp_path / "inode-builder.db"))
+    ref_db_mod.Database.instance = RefDbAdapter(builder_state)
+
+    ts0 = int(time.time()) - 3 * 86400
+    height = [0]
+    revoke_hash = [None]
+
+    async def accept(txs):
+        height[0] += 1
+        return await _ref_accept(ref_manager, txs, ts0 + height[0] * 60,
+                                 addr_g)
+
+    async def build():
+        coinbases = []
+        n_fund = 185  # 167 + 1 + 17 coinbases consumed below exactly
+        for _ in range(n_fund):
+            bh = await accept([])
+            hashes = await builder_state.get_block_transaction_hashes(bh)
+            coinbases.append(hashes[0])
+
+        C = Decimal(6)
+
+        def consolidate(srcs, outputs):
+            tx = Transaction(
+                [TransactionInput(h, 0, private_key=d_g) for h in srcs],
+                outputs)
+            tx.sign()
+            return tx
+
+        # fund the inode key with 1001 coins (167 coinbases + change)
+        tx_fund_i = consolidate(
+            coinbases[:167],
+            [TransactionOutput(addr_i, Decimal(1001)),
+             TransactionOutput(addr_g, 167 * C - Decimal(1001))])
+        # stake g (delegate + future validator) — first-time power mint
+        tx_stake_g = Transaction(
+            [TransactionInput(coinbases[167], 0, private_key=d_g)],
+            [TransactionOutput(addr_g, Decimal(3), RefOT.STAKE),
+             TransactionOutput(addr_g, C - Decimal(3)),
+             TransactionOutput(addr_g, Decimal(10),
+                               RefOT.DELEGATE_VOTING_POWER)])
+        tx_stake_g.sign()
+        await accept([tx_fund_i, tx_stake_g])
+
+        # g registers as validator (needs 100 from 17 coinbases)
+        tx_fund_v = consolidate(
+            coinbases[168:185],
+            [TransactionOutput(addr_g, 17 * C)])
+        await accept([tx_fund_v])
+        tx_vreg = Transaction(
+            [TransactionInput(tx_fund_v.hash(), 0, private_key=d_g)],
+            [TransactionOutput(addr_g, Decimal(100),
+                               RefOT.VALIDATOR_REGISTRATION),
+             TransactionOutput(addr_g, Decimal(10),
+                               RefOT.VALIDATOR_VOTING_POWER),
+             TransactionOutput(addr_g, 17 * C - Decimal(100))],
+            message=b"5")
+        tx_vreg.sign()
+        await accept([tx_vreg])
+
+        # i stakes then registers as inode (exactly 1000)
+        tx_stake_i = Transaction(
+            [TransactionInput(tx_fund_i.hash(), 0, private_key=d_i)],
+            [TransactionOutput(addr_i, Decimal("0.5"), RefOT.STAKE),
+             TransactionOutput(addr_i, Decimal("1000.5")),
+             TransactionOutput(addr_i, Decimal(10),
+                               RefOT.DELEGATE_VOTING_POWER)])
+        tx_stake_i.sign()
+        await accept([tx_stake_i])
+        tx_ireg = Transaction(
+            [TransactionInput(tx_stake_i.hash(), 1, private_key=d_i)],
+            [TransactionOutput(addr_i, Decimal(1000),
+                               RefOT.INODE_REGISTRATION),
+             TransactionOutput(addr_i, Decimal("0.5"))])
+        tx_ireg.sign()
+        await accept([tx_ireg])
+
+        # validator g votes 10 for inode i (spends g's VALIDATOR power)
+        tx_vote = Transaction(
+            [TransactionInput(tx_vreg.hash(), 1, private_key=d_g)],
+            [TransactionOutput(addr_i, Decimal(10),
+                               RefOT.VOTE_AS_VALIDATOR)],
+            message=b"6")
+        tx_vote.sign()
+        await accept([tx_vote])
+
+        await accept([])  # spacing
+
+        # g revokes the inode vote (~3 days old > 48 h window)
+        tx_revoke = Transaction(
+            [TransactionInput(tx_vote.hash(), 0, private_key=d_g)],
+            [TransactionOutput(addr_g, Decimal(10),
+                               RefOT.VALIDATOR_VOTING_POWER)],
+            message=b"8")
+        tx_revoke.sign()
+        await accept([tx_revoke])
+        revoke_hash[0] = tx_revoke.hash()
+
+        # with the vote revoked the inode is inactive: de-register
+        tx_dereg = Transaction(
+            [TransactionInput(tx_ireg.hash(), 0, private_key=d_i)],
+            [TransactionOutput(addr_i, Decimal(1000))],
+            message=b"4")
+        tx_dereg.sign()
+        await accept([tx_dereg])
+
+    async def extra_checks(st):
+        assert await st.is_validator_registered(addr_g)
+        assert not await st.is_inode_registered(addr_i)  # de-registered
+        assert await st.get_stake_outputs(addr_i)
+        # the revoked voting power is back as a validators_voting_power
+        # output created by the revoke tx
+        assert await st.outpoints_exist(
+            [(revoke_hash[0], 0)], _TABLES["vpow"]) == [True]
+        assert (await st.get_address_balance(addr_i)) >= 1000 * SMALLEST
+
+    try:
+        asyncio.run(build())
+        assert height[0] == 194
+        asyncio.run(_replay_into_fresh_node(
+            tmp_path, builder_state, 194, "inode-replay", extra_checks))
     finally:
         ref_db_mod.Database.instance = None
         builder_state.close()
