@@ -499,6 +499,42 @@ def test_device_utxo_index_matches_sql(keys, monkeypatch):
     assert on[2] == [False, True, False]
 
 
+def test_fee_memo_invalidated_by_reorg(keys):
+    """The per-object fee memo (views.tx_fees) must not outlive a
+    reorg: after remove_blocks deletes a tx's SOURCE transaction, the
+    same tx object must report fee 0 (the reference recomputes from the
+    now-missing source) — a stale memoized fee would feed the coinbase
+    split."""
+
+    async def scenario():
+        state = ChainState()
+        manager = BlockManager(state, sig_backend="host")
+        await mine_and_accept(manager, state, keys["a1"], ts_offset=-4)
+        tx = await make_send(state, keys["d1"], keys["a1"], keys["a2"],
+                             2 * SMALLEST)
+        await mine_and_accept(manager, state, keys["a1"], txs=[tx],
+                              ts_offset=-2)
+        # a spend of block-2's coinbase: its fee memoizes nonzero
+        cb2 = (await state.get_spendable_outputs(keys["a1"]))
+        src = [i for i in cb2 if i.amount == 6 * SMALLEST][0]
+        from upow_tpu.core.tx import Tx, TxInput, TxOutput
+
+        spend = Tx([TxInput(src.tx_hash, src.index)],
+                   [TxOutput(keys["a2"], 5 * SMALLEST)])
+        from upow_tpu.core import curve
+
+        spend.sign([keys["d1"]], lambda _i: curve.point_mul_G(keys["d1"]))
+        fee1 = await state.tx_fees(spend)
+        assert fee1 == 1 * SMALLEST
+        # reorg away block 2 (the source tx vanishes); same OBJECT
+        await state.remove_blocks(2)
+        assert await state.tx_fees(spend) == 0, \
+            "stale fee memo survived the reorg"
+        state.close()
+
+    run(scenario())
+
+
 def test_amount_cache_cleared_on_rollback():
     """Output amounts warmed from rows inserted inside a failed atomic()
     must not survive the rollback (they feed tx_fees -> the coinbase)."""

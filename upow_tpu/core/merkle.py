@@ -32,10 +32,17 @@ def merkle_root(transactions: Iterable[TxLike]) -> str:
     instead of silently keying storage with a wrong txid."""
     pairs = []
     for tx in transactions:
-        raw = _raw(tx)
-        digest = (hashlib.sha256(raw).digest() if isinstance(tx, str)
-                  else bytes.fromhex(tx.hash()))
-        pairs.append((raw, digest))
+        if isinstance(tx, str):
+            # lowercase so the hex-string sort key stays byte-order
+            # equivalent (nibble -> hex char is monotonic, so sorting
+            # the hex text equals sorting the raw bytes — no fromhex
+            # per tx just for the sort key)
+            key = tx.lower()
+            digest = hashlib.sha256(bytes.fromhex(key)).digest()
+        else:
+            key = tx.hex()  # memoized, lowercase by construction
+            digest = bytes.fromhex(tx.hash())
+        pairs.append((key, digest))
     pairs.sort(key=lambda p: p[0])
     return hashlib.sha256(b"".join(d for _, d in pairs)).hexdigest()
 

@@ -186,6 +186,8 @@ class PgChainState(StateViews):
                 # would let this task's later writes bypass the lock
                 self._in_atomic = False
                 self._txn_owner = None
+                if rolled_back:
+                    self._bump_fees_gen()  # memos may hold discarded rows
                 if rolled_back and \
                         self._index_mutations != mutations_at_entry:
                     # in-memory index mutations from the discarded
@@ -431,6 +433,7 @@ class PgChainState(StateViews):
                 [(h,) for h in created])
             await self.drv.aexecute(
                 "DELETE FROM blocks WHERE id >= $1", (from_block_id,))
+            self._bump_fees_gen()
         # wholesale resync (restores don't update the index per row);
         # under the writer lock so a concurrent accept committing between
         # our fetches and the swap can't be clobbered by a stale snapshot

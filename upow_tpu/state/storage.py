@@ -306,6 +306,7 @@ class ChainState(StateViews):
         except BaseException:
             self.db.rollback()
             self._amount_cache.clear()  # may hold rolled-back rows
+            self._bump_fees_gen()
             self._index_rebuild()  # undo any index updates the txn made
             raise
         finally:
@@ -444,6 +445,7 @@ class ChainState(StateViews):
         )
         self.db.execute("DELETE FROM blocks WHERE id >= ?", (from_block_id,))
         self._amount_cache_drop(created)
+        self._bump_fees_gen()
         self._commit()
         self._index_rebuild()  # reorgs are rare; a bulk resync is ms
 

@@ -49,16 +49,35 @@ class StateViews:
     # ------------------------------------------------------------- fees ---
 
     async def tx_fees(self, tx: AnyTx) -> int:
-        """fee = Σ input amounts − Σ output amounts (int smallest units)."""
+        """fee = Σ input amounts − Σ output amounts (int smallest units).
+
+        Memoized on the tx object: source amounts are content-addressed
+        by (tx_hash, index) and therefore immutable for a given input
+        set, so a tx's fee never changes — and block accept computes it
+        three times per tx (rules check, reward sum, storage row)."""
         if tx.is_coinbase:
             return 0
+        # scoped by the state's fees generation (bumped on reorg, like
+        # _amount_cache_drop): a tx object held across a remove_blocks
+        # must not keep a fee whose source tx no longer exists — the
+        # gone-source -> fee=0 decision is consensus (storage.py note)
+        gen = getattr(self, "_fees_gen", 0)
+        memo = getattr(tx, "_fees_units", None)
+        if memo is not None and memo[0] == gen:
+            return memo[1]
         total_in = 0
         for i in tx.inputs:
             amount = await self.get_output_amount(i.tx_hash, i.index)
             if amount is None:
-                return 0
+                return 0  # unresolvable input: not memoized (may appear)
             total_in += amount
-        return tx.fees(total_in)
+        fee = tx.fees(total_in)
+        tx._fees_units = (gen, fee)
+        return fee
+
+    def _bump_fees_gen(self) -> None:
+        """Invalidate every outstanding per-object fee memo (reorg)."""
+        self._fees_gen = getattr(self, "_fees_gen", 0) + 1
 
     # ----------------------------------------------------- transactions ---
 
