@@ -285,6 +285,22 @@ def config5_sharded(seconds: float):
     _emit(f"mine_d8_sharded_{n_dev}x_{_platform()}", rate, "MH/s", base_rate)
 
 
+def _python_verify_baseline(seconds: float = 1.0) -> float:
+    """Serial pure-python ECDSA verify rate — the baseline convention
+    for the accept/intake/sync configs (the reference's dominant per-tx
+    cost is one fastecdsa verify per input)."""
+    from upow_tpu.core import curve
+
+    dd, bpub = curve.keygen(rng=0xBA5E)
+    sig = curve.sign(b"base", dd)
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < seconds:
+        curve.verify(sig, b"base", bpub)
+        n += 1
+    return n / (time.perf_counter() - t0)
+
+
 async def _chain_with_utxo_fanout(n_fan: int, n_per: int, rng_key: int):
     """3-block chain fanning one coinbase into n_fan x n_per spendable
     leaf outputs (shared scaffolding for the accept/intake configs).
@@ -409,14 +425,7 @@ def config6_block8k(seconds: float):
     # baseline: the reference's accept path verifies each input serially
     # (fastecdsa in C there; our measured pure-python loop here is the
     # same-host stand-in, consistent with the other configs)
-    dd, bpub = curve.keygen(rng=0xBA5E)
-    sig = curve.sign(b"base", dd)
-    t0 = time.perf_counter()
-    n_base = 0
-    while time.perf_counter() - t0 < seconds:
-        curve.verify(sig, b"base", bpub)
-        n_base += 1
-    base_rate = n_base / (time.perf_counter() - t0)
+    base_rate = _python_verify_baseline(seconds)
 
     rate_cold, rate_warm = asyncio.run(scenario())
     from upow_tpu.core import clock
@@ -487,18 +496,88 @@ def config8_intake(seconds: float):
 
     # baseline: serial pure-python verify, one per tx (the dominant
     # reference-side cost of intake)
-    dd, bpub = curve.keygen(rng=0xBA5E)
-    sig = curve.sign(b"base", dd)
-    t0 = time.perf_counter()
-    n_base = 0
-    while time.perf_counter() - t0 < 1.0:
-        curve.verify(sig, b"base", bpub)
-        n_base += 1
-    base_rate = n_base / (time.perf_counter() - t0)
+    base_rate = _python_verify_baseline()
 
     rate = asyncio.run(scenario())
     clock.reset()
     _emit(f"push_tx_intake_{_platform()}", rate, "tx/s", base_rate)
+
+
+def config9_sync(seconds: float):
+    """End-to-end chain sync over real localhost HTTP: node B downloads
+    node A's chain in pages (prefetch pipeline, page-level signature
+    dispatch, batched txid seeding per device config) and accepts every
+    block — the full reference catch-up path (main.py:97-150) measured
+    as wire-to-state throughput."""
+    import tempfile
+
+    from aiohttp.test_utils import TestServer
+
+    from upow_tpu.config import Config
+    from upow_tpu.core import clock
+    from upow_tpu.node.app import Node
+    from upow_tpu.state import ChainState
+
+    N_BLOCKS = 240  # after the 3 fan-out blocks; 2 spends per block
+
+    async def scenario():
+        state, manager, d, pub, addr, mids, mine_block = \
+            await _chain_with_utxo_fanout(10, 64, 0x57AC)
+        leaves = _leaf_spends(mids, addr, d, pub)
+        assert len(leaves) >= 2 * N_BLOCKS
+        it = iter(leaves)
+        for _ in range(N_BLOCKS):
+            await mine_block([next(it), next(it)])
+        total_blocks = 3 + N_BLOCKS
+        # block 1 is coinbase-only; then the fan (1 tx), the mids (10),
+        # and 2 spends per measured block — plus one coinbase each
+        total_txs = sum(1 + n for n in ([0, 1, 10] + [2] * N_BLOCKS))
+
+        def node_cfg(tmp, name):
+            cfg = Config()
+            cfg.node.db_path = ""
+            cfg.node.seed_url = ""
+            cfg.node.peers_file = f"{tmp}/{name}-nodes.json"
+            cfg.node.ip_config_file = ""
+            cfg.node.sync_fetch_interval = 0.0
+            cfg.node.sync_page = 64  # several pages: prefetch pipeline on
+            cfg.log.path = ""
+            cfg.log.console = False
+            return cfg
+
+        with tempfile.TemporaryDirectory() as tmp:
+            node_a = Node(node_cfg(tmp, "a"), state=state)
+            server_a = TestServer(node_a.app)
+            await server_a.start_server()
+            node_a.started = True
+            node_a.rate_limiter.enabled = False
+            # node B needs no HTTP server: it syncs as a CLIENT of A
+            node_b = Node(node_cfg(tmp, "b"), state=ChainState())
+            node_b.started = True
+            try:
+                t0 = time.perf_counter()
+                ok = await node_b.sync_blockchain(
+                    f"http://127.0.0.1:{server_a.port}")
+                elapsed = time.perf_counter() - t0
+                assert ok is True, ok
+                assert (await node_b.state.get_next_block_id()
+                        == total_blocks + 1)
+                assert (await node_a.state.get_unspent_outputs_hash()
+                        == await node_b.state.get_unspent_outputs_hash())
+            finally:
+                await server_a.close()
+                await node_a.close()
+                await node_b.close()
+        return total_blocks / elapsed, total_txs / elapsed
+
+    # baseline convention (config 6): serial pure-python verify — the
+    # reference's dominant per-tx catch-up cost
+    base_rate = _python_verify_baseline()
+
+    blocks_s, txs_s = asyncio.run(scenario())
+    clock.reset()
+    _emit(f"sync_http_blocks_{_platform()}", blocks_s, "blocks/s", None)
+    _emit(f"sync_http_txs_{_platform()}", txs_s, "tx/s", base_rate)
 
 
 def config7_txid_batch(seconds: float):
@@ -552,6 +631,7 @@ def main() -> int:
         "6": lambda: config6_block8k(args.seconds),
         "7": lambda: config7_txid_batch(args.seconds),
         "8": lambda: config8_intake(args.seconds),
+        "9": lambda: config9_sync(args.seconds),
     }
     needs_device = {"2", "3", "5", "7"}
     for key in args.configs.split(","):
