@@ -1,7 +1,7 @@
-"""The five BASELINE.json measurement configs plus the block-accept
-config, one JSON line each.
+"""The five BASELINE.json measurement configs plus the chain-level
+configs, one JSON line each.
 
-    python bench_suite.py [--configs 1,2,3,4,5,6] [--seconds N]
+    python bench_suite.py [--configs 1,...,9] [--seconds N]
 
 1. miner single-block sha256 at difficulty 1 (CPU reference loop)
 2. fractional difficulty 6.3 mine (charset-restricted prefix match)
@@ -11,9 +11,13 @@ config, one JSON line each.
 6. full 8,160-tx block accept through BlockManager, cold (signatures
    never seen) and warm (every tx intake-verified first — the gossip
    profile, where the verdict cache removes signature work)
+7. host-vs-device batched txid hashing crossover (sync pages)
+8. push_tx intake over real localhost HTTP (per-tx gossip ingest)
+9. end-to-end HTTP chain sync, wire to state (cold catch-up)
 
-``bench.py`` stays the driver-facing single-line headline (sha256 search);
-this suite is the full scoreboard.  Each line mirrors bench.py's shape:
+``bench.py`` stays the driver-facing single-line headline (sha256
+search + the verify sub-metric); this suite is the full scoreboard.
+Each line mirrors bench.py's shape:
 ``{"metric", "value", "unit", "vs_baseline"}``.
 """
 
