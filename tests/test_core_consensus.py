@@ -256,3 +256,26 @@ def test_next_difficulty_retarget_boundaries():
         == START_DIFFICULTY
     # just before the floor activates: sub-6 difficulties legal
     assert retarget(590_500, 6.2, BLOCKS_COUNT * BLOCK_TIME * 50) == D("4.8")
+
+    # pre-590600 wedge (reference-faithful): with no floor, a sustained
+    # slightly-slow chain ratchets 0.1/window through zero into NEGATIVE
+    # difficulty — where floor(d) = -1 makes the PoW target demand 63
+    # matching prefix chars of the previous hash, i.e. unminable.  A
+    # 47-minute soak whose live clock base added ~1 s/block reproduced
+    # exactly this (now prevented in tests by clock.freeze); mainnet
+    # itself was patched only from block 590600 (manager.py:114-116).
+    diff = D("0.1")
+    ts = 0
+    for w in range(3):
+        block_id = 1000 + 100 * w
+        lb = {"id": block_id, "timestamp": ts + 99 * 61, "difficulty": diff}
+        diff = next_difficulty(lb, ts)
+        ts += 100 * 61
+    assert diff < 0, diff  # drifted through zero, no floor pre-590600
+    from upow_tpu.core.difficulty import check_pow_hash
+
+    prev = "ab" * 32
+    # any digest: the negative-difficulty target cannot be satisfied
+    # (other than by echoing the previous hash's own tail, which sha256
+    # will not do)
+    assert not check_pow_hash("11" * 32, prev, diff)

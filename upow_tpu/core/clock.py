@@ -11,11 +11,13 @@ from __future__ import annotations
 import time
 
 _offset = 0
+_frozen = None
 
 
 def timestamp() -> int:
     """Whole seconds since the epoch, UTC (+ any injected test offset)."""
-    return int(time.time()) + _offset
+    base = _frozen if _frozen is not None else int(time.time())
+    return base + _offset
 
 
 def advance(seconds: int) -> None:
@@ -24,6 +26,21 @@ def advance(seconds: int) -> None:
     _offset += int(seconds)
 
 
-def reset() -> None:
-    global _offset
+def freeze(epoch: int) -> None:
+    """Pin the base clock to a fixed epoch (tests only): long soaks must
+    advance chain time ONLY via :func:`advance` — with a live base, real
+    runtime inflates block spacing, and a sustained ~1 s/block of extra
+    wall time walks the retarget below zero, where the difficulty target
+    becomes unsatisfiable (a reference-faithful wedge: the
+    START_DIFFICULTY floor only applies from block 590600,
+    manager.py:116-118).  Clears any accumulated offset so the clock is
+    genuinely pinned to ``epoch``."""
+    global _frozen, _offset
+    _frozen = int(epoch)
     _offset = 0
+
+
+def reset() -> None:
+    global _offset, _frozen
+    _offset = 0
+    _frozen = None
