@@ -109,6 +109,60 @@ def test_push_tx_endpoint_survives_garbage(tmp_path):
     asyncio.run(main())
 
 
+def test_read_endpoints_survive_garbage_params(tmp_path):
+    """Garbage query params on every read endpoint: the node must
+    answer JSON (ok:false, an error status, or an empty result) — never
+    a 500 — and keep serving afterwards."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from upow_tpu.node.app import Node
+    from test_node import make_config
+
+    async def main():
+        node = Node(make_config(tmp_path, "fuzz-read"))
+        server = TestServer(node.app)
+        await server.start_server()
+        client = TestClient(server)
+        node.started = True
+        node.rate_limiter.enabled = False
+        try:
+            garbage = ["", "zz", "-1", "1e9", "None", "🜏", "0x10",
+                       "9" * 40, "' OR 1=1 --"]
+            cases = [
+                # the page*limit PRODUCT must not overflow int64 either
+                ("/get_address_transactions",
+                 {"address": "x", "page": str(2 ** 63 - 1),
+                  "limit": "1000"}),
+            ]
+            for g in garbage:
+                cases += [
+                    ("/get_block", {"block": g}),
+                    ("/get_block_details", {"block": g}),
+                    ("/get_blocks", {"offset": g, "limit": g}),
+                    ("/get_blocks_details", {"offset": g, "limit": g}),
+                    ("/get_transaction", {"tx_hash": g}),
+                    ("/get_address_info", {"address": g}),
+                    ("/get_address_transactions", {"address": g,
+                                                   "limit": g}),
+                    ("/get_validators_info", {"inode": g, "offset": g,
+                                              "limit": g}),
+                    ("/get_delegates_info", {"validator": g, "offset": g,
+                                             "limit": g}),
+                ]
+            for path, params in cases:
+                resp = await client.get(path, params=params)
+                assert resp.status < 500, (path, params, resp.status)
+                await resp.json()  # parseable JSON, whatever the verdict
+            resp = await client.get("/get_mining_info")
+            assert (await resp.json())["ok"]
+        finally:
+            await node.close()
+            await client.close()
+            await server.close()
+
+    asyncio.run(main())
+
+
 def test_push_tx_rejects_coinbase_and_unsigned(tmp_path):
     """A pushed coinbase would pass every input-based check vacuously and
     poison the mempool (reference database.py:93-96 rejects it); a blob

@@ -44,6 +44,28 @@ log = get_logger("node")
 
 GENESIS_PREV_HASH = (18_884_643).to_bytes(32, ENDIAN).hex()
 
+
+class _BadParam(Exception):
+    """Malformed query parameter — answered as a 422 validation error
+    (the reference's FastAPI layer rejects type mismatches the same
+    way; a raw int() here used to 500)."""
+
+
+def _int_q(q, name: str, default: int, cap: int = None) -> int:
+    raw = q.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise _BadParam(name) from None
+    # clamp into [0, int64 max]: a 10^40 offset would overflow the
+    # sqlite INTEGER binding into a 500, and NEGATIVE values are worse
+    # than an error — sqlite treats LIMIT -1 as "no limit" (an
+    # unbounded table dump) and postgres rejects it mid-handler
+    value = max(0, min(value, 2 ** 63 - 1))
+    return min(value, cap) if cap is not None else value
+
 # the one banned address (main.py:426-430)
 _BANNED_ADDRESSES = {"DgQKikeDqS2Fzue23KuA36L4eJSFh649zA9jJ6zwbzUMp"}
 
@@ -272,6 +294,10 @@ class Node:
             response = await handler(request)
         except web.HTTPException:
             raise
+        except _BadParam as e:
+            return web.json_response(
+                {"ok": False, "error": f"Invalid integer parameter {e}"},
+                status=422)
         except Exception as e:  # exception envelope (main.py:394-406)
             log.error("Error on %s, %s: %s", request.path, type(e).__name__,
                       e, exc_info=True)
@@ -606,7 +632,8 @@ class Node:
         """Inode ballot grouped by voting validator (main.py:698-725)."""
         q = request.rel_url.query
         inode = q.get("inode")
-        offset, limit = int(q.get("offset", 0)), min(int(q.get("limit", 100)), 1000)
+        offset = _int_q(q, "offset", 0)
+        limit = _int_q(q, "limit", 100, cap=1000)
         rows = await self.state.get_ballots(
             "inodes_ballot", inode, offset=offset, limit=limit)
         by_validator: dict = {}
@@ -631,7 +658,8 @@ class Node:
         (main.py:727-764)."""
         q = request.rel_url.query
         validator = q.get("validator")
-        offset, limit = int(q.get("offset", 0)), min(int(q.get("limit", 100)), 1000)
+        offset = _int_q(q, "offset", 0)
+        limit = _int_q(q, "limit", 100, cap=1000)
         rows = await self.state.get_ballots(
             "validators_ballot", validator, offset=offset, limit=limit)
         stakes = await self.state.get_multiple_address_stakes(
@@ -738,10 +766,12 @@ class Node:
     async def h_get_address_transactions(self, request: web.Request) -> web.Response:
         q = request.rel_url.query
         address = q.get("address")
-        page = max(int(q.get("page", 1)), 1)
-        limit = min(int(q.get("limit", 5)), 1000)
+        page = max(_int_q(q, "page", 1), 1)
+        limit = _int_q(q, "limit", 5, cap=1000)
+        # the PRODUCT can overflow int64 even with both factors clamped
+        offset = min((page - 1) * limit, 2 ** 63 - 1)
         rows = await self.state.get_address_transactions(
-            address, limit=limit, offset=(page - 1) * limit)
+            address, limit=limit, offset=offset)
         return web.json_response({"ok": True, "result": {
             "transactions": [
                 await self.state.get_nice_transaction(r["tx_hash"])
@@ -787,7 +817,11 @@ class Node:
 
     async def _block_lookup(self, block: str) -> Optional[dict]:
         if block.isdecimal():
-            return await self.state.get_block_by_id(int(block))
+            block_id = int(block)
+            if block_id > 2 ** 63 - 1:
+                return None  # beyond any storable id (sqlite INTEGER
+                # binding would otherwise overflow into a 500)
+            return await self.state.get_block_by_id(block_id)
         return await self.state.get_block(block)
 
     async def h_get_block(self, request: web.Request) -> web.Response:
@@ -822,16 +856,16 @@ class Node:
 
     async def h_get_blocks(self, request: web.Request) -> web.Response:
         q = request.rel_url.query
-        offset = int(q.get("offset", 0))
-        limit = min(int(q.get("limit", 100)), 1000)
+        offset = _int_q(q, "offset", 0)
+        limit = _int_q(q, "limit", 100, cap=1000)
         blocks = await self.state.get_blocks(offset, limit,
                                              size_capped=True)
         return web.json_response({"ok": True, "result": blocks})
 
     async def h_get_blocks_details(self, request: web.Request) -> web.Response:
         q = request.rel_url.query
-        offset = int(q.get("offset", 0))
-        limit = min(int(q.get("limit", 100)), 1000)
+        offset = _int_q(q, "offset", 0)
+        limit = _int_q(q, "limit", 100, cap=1000)
         blocks = await self.state.get_blocks(offset, limit, tx_details=True,
                                              size_capped=True)
         return web.json_response({"ok": True, "result": blocks})
