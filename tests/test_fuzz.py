@@ -127,7 +127,7 @@ def test_read_endpoints_survive_garbage_params(tmp_path):
         node.rate_limiter.enabled = False
         try:
             garbage = ["", "zz", "-1", "1e9", "None", "🜏", "0x10",
-                       "9" * 40, "' OR 1=1 --"]
+                       "9" * 40, "9" * 5000, "' OR 1=1 --"]
             cases = [
                 # the page*limit PRODUCT must not overflow int64 either
                 ("/get_address_transactions",
@@ -152,7 +152,11 @@ def test_read_endpoints_survive_garbage_params(tmp_path):
             for path, params in cases:
                 resp = await client.get(path, params=params)
                 assert resp.status < 500, (path, params, resp.status)
-                await resp.json()  # parseable JSON, whatever the verdict
+                if resp.content_type == "application/json":
+                    await resp.json()  # parseable, whatever the verdict
+                # else: aiohttp itself refused the request (e.g. an
+                # oversized query string answers 400 text/plain before
+                # our handlers run) — still not a 500
             resp = await client.get("/get_mining_info")
             assert (await resp.json())["ok"]
         finally:

@@ -540,6 +540,75 @@ def test_governance_randomized_churn():
         _diff.next_difficulty = orig_next
 
 
+def test_wallet_cli_governance_lifecycle(tmp_path, capsys):
+    """Every governance CLI arm through the real entry point
+    (reference wallet.py command surface): stake -> register_validator
+    for wallet A; stake -> vote (delegate auto-dispatch) for wallet B;
+     48 h later revoke -> unstake for B.  Each command builds, signs and
+    lands in the shared local chain's mempool, and each mined block
+    moves the governance tables."""
+    from upow_tpu.wallet import cli
+
+    db_file = str(tmp_path / "gov-chain.db")
+    w_a = str(tmp_path / "a.json")
+    w_b = str(tmp_path / "b.json")
+
+    async def run_cli(*argv):
+        rc = await cli.amain([*argv, "--db", db_file, "--node", ""])
+        capsys.readouterr()
+        return rc
+
+    async def scenario():
+        assert await run_cli("createwallet", "--wallet", w_a) == 0
+        assert await run_cli("createwallet", "--wallet", w_b) == 0
+        d_a = int(KeyStore(w_a).keys()[0]["private_key"])
+        addr_a = point_to_string(curve.point_mul(d_a, curve.G))
+        d_b = int(KeyStore(w_b).keys()[0]["private_key"])
+        addr_b = point_to_string(curve.point_mul(d_b, curve.G))
+
+        state = ChainState(db_file)
+        manager = BlockManager(state, sig_backend="host")
+        for _ in range(19):  # 114 coins: validator reg needs 100+
+            await mine_block(manager, state, addr_a)
+
+        async def mine_pending():
+            await mine_block(manager, state, addr_a, include_pending=True)
+
+        # A: stake then register as validator
+        assert await run_cli("stake", "-a", "3", "--wallet", w_a) == 0
+        await mine_pending()
+        assert await run_cli("register_validator", "--wallet", w_a) == 0
+        await mine_pending()
+        assert await state.is_validator_registered(addr_a)
+
+        # B: fund, stake, vote for validator A (delegate auto-dispatch)
+        assert await run_cli("send", "-to", addr_b, "-a", "2",
+                             "--wallet", w_a) == 0
+        await mine_pending()
+        assert await run_cli("stake", "-a", "1", "--wallet", w_b) == 0
+        await mine_pending()
+        assert await run_cli("vote", "-r", "10", "-to", addr_a,
+                             "--wallet", w_b) == 0
+        await mine_pending()
+        assert await state.get_delegates_spent_votes(addr_b)
+
+        # before the 48 h window the revoke must refuse
+        assert await run_cli("revoke", "-from", addr_a,
+                             "--wallet", w_b) == 1
+
+        clock.advance(48 * 3600 + 60)
+        assert await run_cli("revoke", "-from", addr_a,
+                             "--wallet", w_b) == 0
+        await mine_pending()
+        assert not await state.get_delegates_spent_votes(addr_b)
+        assert await run_cli("unstake", "--wallet", w_b) == 0
+        await mine_pending()
+        assert not await state.get_stake_outputs(addr_b)
+        state.close()
+
+    run(scenario())
+
+
 def test_wallet_cli_end_to_end(tmp_path, capsys):
     """The actual CLI entry (`python -m upow_tpu.wallet.cli` surface,
     reference wallet.py:44-62): createwallet -> fund the key on a

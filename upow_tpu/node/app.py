@@ -817,11 +817,12 @@ class Node:
 
     async def _block_lookup(self, block: str) -> Optional[dict]:
         if block.isdecimal():
-            block_id = int(block)
-            if block_id > 2 ** 63 - 1:
-                return None  # beyond any storable id (sqlite INTEGER
-                # binding would otherwise overflow into a 500)
-            return await self.state.get_block_by_id(block_id)
+            # length gate first: int() itself raises past ~4300 digits
+            # (python 3.12 conversion limit); int64 max has 19 digits
+            if len(block) > 19 or int(block) > 2 ** 63 - 1:
+                return None  # beyond any storable id (the sqlite
+                # INTEGER binding would otherwise overflow into a 500)
+            return await self.state.get_block_by_id(int(block))
         return await self.state.get_block(block)
 
     async def h_get_block(self, request: web.Request) -> web.Response:

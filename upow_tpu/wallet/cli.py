@@ -34,6 +34,14 @@ def _string_to_bytes(string: Optional[str]) -> Optional[bytes]:
 
 
 async def push_tx(tx, node_url: str, state: Optional[ChainState]) -> None:
+    if not node_url:
+        # explicit local-only mode (--node ""): straight to the local
+        # chain's mempool, no network attempt
+        if state is None:
+            raise RuntimeError("no node url and no local chain db")
+        await state.add_pending_transaction(tx)
+        print(f"Transaction added to local mempool. Hash: {tx.hash()}")
+        return
     import aiohttp
 
     try:
@@ -75,7 +83,9 @@ async def amain(argv=None) -> int:
 
     cfg = Config.load()
     store = KeyStore(args.wallet)
-    node_url = args.node or cfg.node.seed_url
+    # an EXPLICIT --node "" means local-only (no fallback to the seed:
+    # a test or air-gapped wallet must never push to the public API)
+    node_url = cfg.node.seed_url if args.node is None else args.node
     db_path = args.db if args.db is not None else cfg.node.db_path
     # sole_writer=False: the node may be writing this file concurrently;
     # pay the per-read data_version pragma instead of risking 50 ms of
@@ -120,29 +130,38 @@ async def amain(argv=None) -> int:
 
     key = int(store.keys()[0]["private_key"])
     builder = WalletBuilder(state)
-    if args.command == "send":
-        tx = await builder.create_transaction(
-            key, args.to, args.a, _string_to_bytes(args.message))
-    elif args.command == "sendmany":
-        tx = await builder.create_transaction_to_send_multiple_wallet(
-            key, (args.to or "").split(","), (args.a or "").split(","),
-            _string_to_bytes(args.message))
-    elif args.command == "stake":
-        tx = await builder.create_stake_transaction(key, args.a)
-    elif args.command == "unstake":
-        tx = await builder.create_unstake_transaction(key)
-    elif args.command == "register_inode":
-        tx = await builder.create_inode_registration_transaction(key)
-    elif args.command == "de_register_inode":
-        tx = await builder.create_inode_de_registration_transaction(key)
-    elif args.command == "register_validator":
-        tx = await builder.create_validator_registration_transaction(key)
-    elif args.command == "vote":
-        tx = await builder.create_voting_transaction(key, args.range, args.to)
-    elif args.command == "revoke":
-        tx = await builder.create_revoke_transaction(key, args.revoke_from)
-    else:  # pragma: no cover
-        return 2
+    try:
+        if args.command == "send":
+            tx = await builder.create_transaction(
+                key, args.to, args.a, _string_to_bytes(args.message))
+        elif args.command == "sendmany":
+            tx = await builder.create_transaction_to_send_multiple_wallet(
+                key, (args.to or "").split(","), (args.a or "").split(","),
+                _string_to_bytes(args.message))
+        elif args.command == "stake":
+            tx = await builder.create_stake_transaction(key, args.a)
+        elif args.command == "unstake":
+            tx = await builder.create_unstake_transaction(key)
+        elif args.command == "register_inode":
+            tx = await builder.create_inode_registration_transaction(key)
+        elif args.command == "de_register_inode":
+            tx = await builder.create_inode_de_registration_transaction(key)
+        elif args.command == "register_validator":
+            tx = await builder.create_validator_registration_transaction(key)
+        elif args.command == "vote":
+            tx = await builder.create_voting_transaction(
+                key, args.range, args.to)
+        elif args.command == "revoke":
+            tx = await builder.create_revoke_transaction(
+                key, args.revoke_from)
+        else:  # pragma: no cover
+            return 2
+    except ValueError as e:
+        # builder refusals carry the user-facing reason (the reference
+        # wallet prints these, utils.py raises the same strings) — a
+        # clean message and exit code, not a traceback
+        print(str(e))
+        return 1
     await push_tx(tx, node_url, state)
     return 0
 
