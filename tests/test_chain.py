@@ -355,6 +355,58 @@ def test_mempool_intake_and_gc(keys):
     run(scenario())
 
 
+def test_mempool_gc_evicts_exactly_not_wholesale(keys):
+    """Divergence pin (see clear_pending_transactions docstring): when
+    EVERY checked input of a class is missing, the reference wipes the
+    whole mempool (manager.py:336-338's unfiltered
+    remove_pending_transactions); ours must evict ONLY the affected
+    transactions and keep unrelated live-input entries."""
+
+    async def scenario():
+        from upow_tpu.wallet.builders import WalletBuilder
+
+        state = ChainState()
+        manager = BlockManager(state, sig_backend="host")
+        await mine_and_accept(manager, state, keys["a1"], ts_offset=-6)
+        # block 2 carries a mined stake (+10-power mint): its
+        # delegates_voting_power outpoint survives the later reorg
+        stake = await WalletBuilder(state).create_stake_transaction(
+            keys["d1"], "2")
+        await mine_and_accept(manager, state, keys["a1"], txs=[stake],
+                              ts_offset=-4)
+        h3 = await mine_and_accept(manager, state, keys["a1"], ts_offset=-2)
+        cb3 = (await state.get_block_transaction_hashes(h3))[0]
+
+        pub_of = lambda _i: keys["pub1"]
+        # ghost: REGULAR-class spend of block-3's coinbase (will die)
+        ghost = Tx([TxInput(cb3, 0)],
+                   [TxOutput(keys["a1"], 6 * SMALLEST)]).sign(
+                       [keys["d1"]], pub_of)
+        # live: a vote spending the DVP outpoint — a DIFFERENT checked
+        # input class (delegates_voting_power), whose input survives
+        dvp_idx = next(
+            i for i, o in enumerate(stake.outputs)
+            if o.output_type == OutputType.DELEGATE_VOTING_POWER)
+        live = Tx([TxInput(stake.hash(), dvp_idx)],
+                  [TxOutput(keys["a2"], 10 * SMALLEST,
+                            OutputType.VOTE_AS_DELEGATE)],
+                  message=b"7").sign([keys["d1"]], pub_of)
+        await state.add_pending_transaction(ghost)
+        await state.add_pending_transaction(live)
+
+        await state.remove_blocks(3)  # kills cb3; the DVP outpoint stays
+        await manager.clear_pending_transactions()
+        # the REGULAR class's checked inputs are now 100% missing — the
+        # reference's wipe-all trigger (unfiltered
+        # remove_pending_transactions would take live with it); ours
+        # must evict ONLY ghost
+        assert not await state.pending_transaction_exists(ghost.hash())
+        assert await state.pending_transaction_exists(live.hash())
+        state.close()
+
+    run(scenario())
+
+
 def test_sig_verdict_cache_skips_reverify_at_accept(keys, monkeypatch):
     """A tx verified at mempool intake must not pay signature
     verification again when its block is accepted (the reference

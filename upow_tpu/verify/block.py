@@ -390,7 +390,20 @@ class BlockManager:
 
     async def clear_pending_transactions(self) -> None:
         """Evict mempool entries whose inputs are gone or double-used
-        (manager.py:253-349, without the unbounded recursion)."""
+        (manager.py:253-349).  Deliberate divergences — the mempool is
+        node-local, not consensus, so eviction SELECTION may differ:
+
+        * no unbounded recursion (the reference re-enters itself after
+          every single eviction);
+        * when EVERY checked input of a class is missing, the reference
+          wipes the ENTIRE mempool (verify_outputs' unfiltered
+          remove_pending_transactions, manager.py:336-338) — we evict
+          only the affected transactions;
+        * the reference removes "by contains" — a hex-substring match of
+          outpoint bytes against whole tx hexes (manager.py:343-348),
+          which can false-positive on an unrelated tx whose serialized
+          bytes happen to contain the pattern — we match exact tx
+          hashes."""
         while True:
             txs = await self.state.get_pending_transactions_limit(hex_only=False)
             used: set = set()
