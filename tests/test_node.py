@@ -516,6 +516,51 @@ def test_sync_page_prefills_sig_verdicts(tmp_path, keys, monkeypatch):
     run_cluster(tmp_path, scenario)
 
 
+def test_peer_book_time_window_classes(tmp_path, monkeypatch):
+    """PeerBook's three time classes (nodes_manager.py:97-160): active
+    = messaged within 7 days (sampled for gossip), stale = heard from
+    but beyond the window (NOT gossiped to, pruned after 90 days),
+    never-seen = its own ≤10 sample; plus file persistence."""
+    import time as _time
+
+    from upow_tpu.config import NodeConfig
+    from upow_tpu.node.peers import PeerBook
+
+    now = [1_800_000_000.0]
+    monkeypatch.setattr(_time, "time", lambda: now[0])
+
+    cfg = NodeConfig()
+    cfg.peers_file = str(tmp_path / "nodes.json")
+    book = PeerBook(cfg)
+    assert book.add("http://active.example:3006")
+    assert book.add("stale.example:3006")  # scheme auto-prefixed
+    assert book.add("http://unseen.example:3006/")  # trailing / stripped
+    assert not book.add("http://unseen.example:3006")  # dedup
+
+    book.update_last_message("http://active.example:3006")
+    book.update_last_message("http://stale.example:3006")
+    now[0] += 8 * 86400  # stale's message ages beyond the 7-day window
+    book.update_last_message("http://active.example:3006")
+
+    assert book.recent_nodes() == ["http://active.example:3006"]
+    picks = book.propagate_nodes()
+    assert "http://active.example:3006" in picks
+    assert "http://unseen.example:3006" in picks
+    assert "http://stale.example:3006" not in picks  # beyond the window
+
+    # persistence: a fresh book on the same file sees the same classes
+    book2 = PeerBook(cfg)
+    assert set(book2.all_nodes()) == set(book.all_nodes())
+    assert book2.recent_nodes() == ["http://active.example:3006"]
+
+    # prune: 90 days of silence drops stale AND the never-seen entry
+    # past its added age; the active peer survives via fresh messages
+    now[0] += 83 * 86400
+    book.update_last_message("http://active.example:3006")
+    book.prune()
+    assert book.all_nodes() == ["http://active.example:3006"]
+
+
 def test_node_interface_unwraps_peer_errors():
     """A peer's error envelope (e.g. its 40/min rate-limit body) must
     surface as a readable error, not a KeyError on 'result'."""

@@ -118,12 +118,20 @@ class PeerBook:
         return active or list(self._data)
 
     def propagate_nodes(self) -> List[str]:
-        """≤10 random active + ≤10 random never-seen (nodes_manager.py:144-149)."""
+        """≤10 random active + ≤10 random never-seen (nodes_manager.py:144-149).
+
+        "Active" is the 7-day window (the reference samples
+        get_recent_nodes here): a peer last heard from BEYOND the window
+        is neither active nor never-seen and is not gossiped to."""
         k = self.cfg.propagate_sample
+        now = time.time()
         active = [
-            u for u, meta in self._data.items() if meta.get("last_message", 0) > 0
+            u for u, meta in self._data.items()
+            if meta.get("last_message", 0) > 0
+            and now - meta["last_message"] < self.cfg.active_within
         ]
-        unseen = [u for u in self._data if u not in set(active)]
+        unseen = [u for u, meta in self._data.items()
+                  if meta.get("last_message", 0) == 0]
         picks = random.sample(active, min(k, len(active)))
         picks += random.sample(unseen, min(k, len(unseen)))
         return picks
