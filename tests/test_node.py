@@ -1057,6 +1057,31 @@ def test_ipfilter_endpoint_slash_normalization(tmp_path):
     assert f.allowed("9.9.9.9", endpoint="/get_block")
 
 
+def test_ipfilter_whitelist_is_exclusive(tmp_path):
+    """Reference ip_manager.py:42-44 semantics: a NON-EMPTY whitelist
+    admits only listed IPs (the blocklist is then irrelevant); without
+    one the blocklist denies; endpoint blocks bind everyone — even
+    whitelisted callers (main.py:306 has no bypass)."""
+    from upow_tpu.node.ipfilter import IpFilter
+
+    cfg_path = tmp_path / "ip_config.json"
+    cfg_path.write_text(json.dumps({
+        "whitelist": ["1.1.1.1"], "blocklist": ["2.2.2.2"],
+        "block_endpoints": ["/get_nodes"]}))
+    f = IpFilter(str(cfg_path))
+    assert f.allowed("1.1.1.1")
+    assert not f.allowed("3.3.3.3")  # not listed -> denied (exclusive)
+    assert not f.allowed("2.2.2.2")
+    # endpoint blocks apply even to the whitelisted IP
+    assert not f.allowed("1.1.1.1", endpoint="/get_nodes")
+
+    cfg_path.write_text(json.dumps({
+        "whitelist": [], "blocklist": ["2.2.2.2"], "block_endpoints": []}))
+    f = IpFilter(str(cfg_path))
+    assert f.allowed("3.3.3.3")  # no whitelist -> default allow
+    assert not f.allowed("2.2.2.2")  # blocklist active without whitelist
+
+
 def test_rate_limits(tmp_path, keys):
     """slowapi-parity limits: GET / allows 3/minute then 429s; unlisted
     endpoints (push_block et al.) are never limited (main.py:267...)."""

@@ -41,7 +41,12 @@ def is_local_ip(ip: str) -> bool:
 
 
 class IpFilter:
-    """whitelist > blocklist > block_endpoints, hot-reloaded."""
+    """Reference ip_manager.py semantics, hot-reloaded: a NON-EMPTY
+    whitelist is exclusive (only listed IPs pass; the blocklist is then
+    irrelevant — ip_manager.py:42-44's ``ip in whitelist or (ip not in
+    blocklist and not whitelist)``); with no whitelist, the blocklist
+    denies; endpoint blocks apply to every caller, whitelisted or not
+    (main.py:306 checks them after the IP gate with no bypass)."""
 
     def __init__(self, path: str = "ip_config.json",
                  reload_every: float = 300.0):
@@ -75,9 +80,10 @@ class IpFilter:
 
     def allowed(self, ip: str, endpoint: Optional[str] = None) -> bool:
         self._maybe_reload()
-        if ip in self.whitelist:
-            return True
-        if ip in self.blocklist:
+        if self.whitelist:
+            if ip not in self.whitelist:
+                return False
+        elif ip in self.blocklist:
             return False
         if endpoint is not None and endpoint.strip("/") in self.block_endpoints:
             return False
