@@ -19,12 +19,27 @@ from typing import Optional
 _PRIVATE_NETS = [
     ipaddress.ip_network(n)
     for n in (
+        # the reference's full list (node/utils.py:9-27): RFC1918 plus
+        # every special-purpose v4 range — none of these is a routable
+        # public peer
         "127.0.0.0/8",      # loopback
         "10.0.0.0/8",       # RFC1918
         "172.16.0.0/12",
         "192.168.0.0/16",
+        "0.0.0.0/8",        # "this network"
         "100.64.0.0/10",    # CGNAT
         "169.254.0.0/16",   # link-local
+        "192.0.0.0/24",     # IETF protocol assignments
+        "192.0.2.0/24",     # TEST-NET-1
+        "192.88.99.0/24",   # 6to4 relay (deprecated)
+        "198.18.0.0/15",    # benchmarking
+        "198.51.100.0/24",  # TEST-NET-2
+        "203.0.113.0/24",   # TEST-NET-3
+        "224.0.0.0/4",      # multicast
+        "233.252.0.0/24",   # MCAST-TEST-NET
+        "240.0.0.0/4",      # reserved
+        "255.255.255.255/32",
+        # v6 equivalents (beyond the reference, which is v4-only)
         "::1/128",
         "fc00::/7",
         "fe80::/10",
