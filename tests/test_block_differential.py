@@ -19,6 +19,7 @@ covered by the rewards differential).
 
 import asyncio
 import hashlib
+import os
 import random
 
 import pytest
@@ -597,10 +598,11 @@ def test_check_block_differential_randomized():
     mutation picks; verdicts must agree on every one."""
     ref = load_reference()
     rng = random.Random("block-differential")
+    trials = int(os.environ.get("UPOW_BLOCK_DIFF_TRIALS", "60"))
 
     async def main():
         seen = set()
-        for trial in range(60):
+        for trial in range(trials):
             sc = _base_scenario()
             # randomize address flags (may invalidate tx rules)
             if rng.random() < 0.4:
