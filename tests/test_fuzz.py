@@ -157,6 +157,14 @@ def test_read_endpoints_survive_garbage_params(tmp_path):
                 # else: aiohttp itself refused the request (e.g. an
                 # oversized query string answers 400 text/plain before
                 # our handlers run) — still not a 500
+            # POST JSON ints get the same treatment (push_block's
+            # block_no from a garbage miner)
+            for bad_no in ("zz", "", None, "9" * 5000, -4, [1], {"a": 1}):
+                resp = await client.post("/push_block", json={
+                    "block_content": "00", "txs": [], "block_no": bad_no})
+                assert resp.status < 500, (bad_no, resp.status)
+                body = await resp.json()
+                assert body["ok"] is False
             resp = await client.get("/get_mining_info")
             assert (await resp.json())["ok"]
         finally:

@@ -554,7 +554,15 @@ class Node:
                     {"ok": False, "error": "Previous hash not found"})
             block_no = previous_block["id"] + 1
         else:
-            block_no = int(block_no)
+            try:
+                block_no = int(block_no)
+                if not (0 <= block_no <= 2 ** 63 - 1):
+                    raise ValueError
+            except (ValueError, TypeError):
+                # a miner sending garbage must get a clean rejection,
+                # not a 500 (same class as the _int_q GET hardening)
+                return web.json_response(
+                    {"ok": False, "error": "Invalid block_no"}, status=422)
         if next_block_id < block_no:
             self._spawn(self.sync_blockchain(sender))
             return web.json_response({
