@@ -341,7 +341,8 @@ def test_dpos_rule_differential(rule, tx_type, ref_method, our_method):
     import upow.database as ref_db_mod
     import upow.helpers as ref_helpers
 
-    rng = random.Random(f"dpos-{rule}")
+    seed = os.environ.get("UPOW_DPOS_SEED", "")
+    rng = random.Random(f"dpos-{rule}-{seed}")
     mismatches = []
     verdict_mix = set()
 
