@@ -10,7 +10,7 @@ set -u
 mkdir -p /tmp/tpuq
 cd /root/repo
 ran_queue=0
-for i in $(seq 1 140); do
+for i in $(seq 1 160); do
   if timeout 100 python -c 'import jax; jax.devices()' >/dev/null 2>&1; then
     if [ "$ran_queue" = 0 ]; then
       echo "$(date -u +%H:%M:%S) tunnel healthy, running queue" >> /tmp/tpuq/log

@@ -393,10 +393,15 @@ class MockPgDriver:
     # (the reference-dialect PG_SCHEMA text is pg-only: it spells the
     # outpoint column as unquoted `index`, reserved in sqlite)
 
-    def __init__(self):
+    def __init__(self, threadsafe: bool = False):
         import sqlite3
 
-        self.db = sqlite3.connect(":memory:")
+        # threadsafe=True lets the fake-asyncpg harness (tests/
+        # fake_asyncpg.py) share this sqlite handle across the main
+        # thread and AsyncpgDriver's loop thread; the driver's
+        # per-statement lock serializes actual use.
+        self.db = sqlite3.connect(":memory:",
+                                  check_same_thread=not threadsafe)
         self.db.isolation_level = None  # autocommit; BEGIN/COMMIT explicit
         self.db.row_factory = sqlite3.Row
         self.db.executescript(_MOCK_DDL)
