@@ -109,3 +109,35 @@ def enable(cache_root: str) -> str:
         return path
     except Exception:
         return ""
+
+
+# --- cpu_aot_loader warning triage ---------------------------------------
+
+# The tuning-pref residue documented at the top of this module: reloads
+# of entries compiled BY THIS HOST still mismatch on exactly these two
+# derived preferences, because the host probe never reports them.
+COSMETIC_TUNING_PREFS = frozenset(
+    {"+prefer-no-gather", "+prefer-no-scatter"})
+
+_AOT_MISMATCH = None  # compiled lazily (re import at module top is avoided)
+
+
+def aot_mismatch_features(stderr_text: str) -> set:
+    """Features named by ``cpu_aot_loader`` 'Target machine feature X is
+    not supported on the host machine' lines in ``stderr_text``."""
+    global _AOT_MISMATCH
+    if _AOT_MISMATCH is None:
+        import re
+
+        _AOT_MISMATCH = re.compile(
+            r"Target machine feature\s+(\S+)\s+is\s+not\s+supported")
+    return set(_AOT_MISMATCH.findall(stderr_text))
+
+
+def foreign_aot_mismatches(stderr_text: str) -> set:
+    """Mismatched features BEYOND the documented cosmetic pair — a
+    non-empty result means the loaded AOT entry really was compiled for
+    a different machine (the thing the host fingerprint exists to
+    prevent) and the host cache dir should be evicted, even if the run
+    happened to exit 0."""
+    return aot_mismatch_features(stderr_text) - COSMETIC_TUNING_PREFS
