@@ -1,0 +1,65 @@
+"""Host-number spread runs (VERDICT r4 weak #6): re-run the host-path
+bench configs N times on a quiet machine and report median + min/max
+per metric, mirroring the TPU history convention
+(.tpu_bench_history.jsonl's spread stats).  Results go into
+BENCHMARKS.md's spread table.
+
+    python .host_spread.py [--configs 6,8,9] [--n 5] [--seconds 10]
+
+Each run is a fresh subprocess (fresh sqlite, fresh caches — the
+cross-run variance IS the thing being measured).
+"""
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="6,8,9")
+    ap.add_argument("--n", type=int, default=5)
+    ap.add_argument("--seconds", type=float, default=10)
+    args = ap.parse_args()
+
+    by_metric = {}
+    for i in range(args.n):
+        cmd = [sys.executable, "bench_suite.py", "--configs", args.configs,
+               "--seconds", str(args.seconds)]
+        out = subprocess.run(cmd, cwd=HERE, capture_output=True, text=True,
+                             timeout=1800)
+        if out.returncode != 0:
+            sys.stderr.write(out.stderr[-2000:])
+            raise SystemExit(f"run {i} failed rc={out.returncode}")
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "metric" in rec:
+                by_metric.setdefault(rec["metric"], []).append(
+                    (rec["value"], rec.get("unit", "")))
+        print(f"run {i + 1}/{args.n} done", file=sys.stderr, flush=True)
+
+    print(f"{'metric':<42} {'median':>12} {'min':>12} {'max':>12}  unit")
+    summary = {}
+    for metric, vals in sorted(by_metric.items()):
+        vs = [v for v, _ in vals]
+        unit = vals[0][1]
+        med, lo, hi = statistics.median(vs), min(vs), max(vs)
+        summary[metric] = {"n": len(vs), "median": med, "min": lo,
+                           "max": hi, "unit": unit}
+        print(f"{metric:<42} {med:>12,.0f} {lo:>12,.0f} {hi:>12,.0f}  {unit}")
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()
