@@ -712,12 +712,18 @@ def test_pg_reorg_snapshot_shares_writer_lock_with_deletes():
     run(main())
 
 
-def test_pg_concurrent_churn():
+@pytest.mark.parametrize("driver_kind", ["mock", "fake-asyncpg"])
+def test_pg_concurrent_churn(driver_kind, monkeypatch):
     """Randomized concurrent churn over the async pg backend: a miner
     accepting blocks, a mempool intake task, a propagation updater, and
     readers all interleave at every driver yield point.  Invariants at
     the end: the chain replays to the same fingerprint and the mempool
-    overlay is consistent.  UPOW_SOAK_ROUNDS scales it."""
+    overlay is consistent.  UPOW_SOAK_ROUNDS scales it.
+
+    The fake-asyncpg variant runs the same churn through the REAL
+    AsyncpgDriver — every interleaving point additionally crosses the
+    driver's loop thread under its per-statement lock, the surface the
+    in-process mock cannot exercise."""
     import random
 
     rounds = int(os.environ.get("UPOW_SOAK_ROUNDS", "6"))
@@ -729,8 +735,19 @@ def test_pg_concurrent_churn():
     # 5000 rounds at ~1 s/block of wall time reproduced exactly that.
     clock.freeze(1_753_791_000)
 
+    def make_churn_state():
+        if driver_kind == "mock":
+            return PgChainState(driver=MockPgDriver())
+        import sys
+
+        import fake_asyncpg
+
+        monkeypatch.setitem(sys.modules, "asyncpg", fake_asyncpg)
+        srv = fake_asyncpg.FakeServer("postgresql://fake/churn")
+        return PgChainState(srv.dsn)
+
     async def main():
-        state = PgChainState(driver=MockPgDriver())
+        state = make_churn_state()
         manager = BlockManager(state, sig_backend="host")
         builder = WalletBuilder(state)
         actors = make_actors()
@@ -811,4 +828,10 @@ def test_pg_concurrent_churn():
         assert pending_hashes
         state.close()
 
-    run(main())
+    try:
+        run(main())
+    finally:
+        if driver_kind == "fake-asyncpg":
+            import fake_asyncpg
+
+            fake_asyncpg.reset()
