@@ -598,7 +598,10 @@ def test_check_block_differential_randomized():
     """Random combinations: flags from the DPoS generator + random
     mutation picks; verdicts must agree on every one."""
     ref = load_reference()
-    rng = random.Random("block-differential")
+    # UPOW_BLOCK_DIFF_SEED varies the sweep for fresh randomized soaks
+    # (same convention as the DPoS differential's UPOW_DPOS_SEED)
+    rng = random.Random(
+        "block-differential" + os.environ.get("UPOW_BLOCK_DIFF_SEED", ""))
     trials = int(os.environ.get("UPOW_BLOCK_DIFF_TRIALS", "60"))
 
     async def main():

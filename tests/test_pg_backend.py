@@ -393,13 +393,27 @@ def test_pg_reindex_check_detects_corruption():
     run(main())
 
 
-def test_pg_backend_sync_page_ingest(tmp_path):
+@pytest.mark.parametrize("driver_kind", ["mock", "fake-asyncpg"])
+def test_pg_backend_sync_page_ingest(tmp_path, driver_kind, monkeypatch):
     """The node's page-ingest sync path (create_blocks →
     create_block_syncing) runs against the pg backend and reproduces
     the sqlite source chain's fingerprint — the drop-in scenario of a
-    pg-backed node catching up from a reference-shaped peer."""
+    pg-backed node catching up from a reference-shaped peer.  The
+    fake-asyncpg variant drives the same page batching (executemany per
+    table) through the REAL AsyncpgDriver's loop thread."""
     from upow_tpu.config import Config
     from upow_tpu.node.app import Node
+
+    def make_pg_state():
+        if driver_kind == "mock":
+            return PgChainState(driver=MockPgDriver())
+        import sys
+
+        import fake_asyncpg
+
+        monkeypatch.setitem(sys.modules, "asyncpg", fake_asyncpg)
+        srv = fake_asyncpg.FakeServer("postgresql://fake/sync-ingest")
+        return PgChainState(srv.dsn)
 
     async def main():
         src = ChainState()
@@ -422,7 +436,7 @@ def test_pg_backend_sync_page_ingest(tmp_path):
         cfg.device.sig_backend = "host"
         cfg.log.path = ""
         cfg.log.console = False
-        node = Node(cfg, state=PgChainState(driver=MockPgDriver()))
+        node = Node(cfg, state=make_pg_state())
 
         page = await src.get_blocks(1, 100)
         errors = []
@@ -434,7 +448,13 @@ def test_pg_backend_sync_page_ingest(tmp_path):
         src.close()
         await node.close()
 
-    run(main())
+    try:
+        run(main())
+    finally:
+        if driver_kind == "fake-asyncpg":
+            import fake_asyncpg
+
+            fake_asyncpg.reset()
 
 
 def test_pg_device_index_matches_sql():
