@@ -178,7 +178,10 @@ def _measure_verify(platform: str, seconds: float) -> dict:
         inputs, *_ = P._pack_device_inputs(digests, sigs, pubs, n_lanes)
 
         def kernel_call():
-            return P._prep_and_verify_pallas_jac(inputs, tile=tile)
+            # w passed explicitly: the jitted default binds _WINDOW at
+            # module load, NOT the PALLAS_JAC_WINDOW knob
+            return P._prep_and_verify_pallas_jac(
+                inputs, tile=tile, w=P.PALLAS_JAC_WINDOW)
 
         res = np.asarray(jax.block_until_ready(kernel_call()))  # warm/compile
         assert bool(res[0].all()) and not bool(res[1].any())
@@ -188,7 +191,8 @@ def _measure_verify(platform: str, seconds: float) -> dict:
 
         def dispatch():
             pk, *_ = P._pack_device_inputs(digests, sigs, pubs, n_lanes)
-            return P._prep_and_verify_pallas_jac(pk, tile=tile)
+            return P._prep_and_verify_pallas_jac(
+                pk, tile=tile, w=P.PALLAS_JAC_WINDOW)
 
         def check(r):
             r = np.asarray(r)
@@ -294,6 +298,9 @@ def main() -> int:
                     help="pipelined dispatches in flight")
     ap.add_argument("--trace-dir", default=None,
                     help="capture a jax.profiler trace of the measurement")
+    ap.add_argument("--require-tpu", action="store_true",
+                    help="exit 3 instead of falling back to CPU (tpu_watch "
+                         "must not mark a queue step done on a CPU number)")
     args = ap.parse_args()
 
     import jax
@@ -313,8 +320,14 @@ def main() -> int:
                 "error": "no jax backend available",
             })))
             return 0
+        if args.require_tpu:
+            sys.stderr.write("--require-tpu: backend hung, not falling back\n")
+            return 3
         sys.stderr.write("falling back to scrubbed-env CPU child\n")
         return _reexec_cpu_child()
+    if args.require_tpu and platform == "cpu":
+        sys.stderr.write("--require-tpu: only cpu available\n")
+        return 3
     if args.batch == 0:
         args.batch = 1 << 20 if platform == "cpu" else 1 << 28
     if platform == "cpu" and args.batch > 1 << 20:

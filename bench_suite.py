@@ -177,7 +177,10 @@ def config3_batch_verify(seconds: float):
 
         def dispatch():
             inputs, *_meta = P._pack_device_inputs(digests, sigs, pubs, 8192)
-            return P._prep_and_verify_pallas_jac(inputs, tile=tile)
+            # w passed explicitly: the jitted default binds _WINDOW at
+            # module load, NOT the PALLAS_JAC_WINDOW knob
+            return P._prep_and_verify_pallas_jac(
+                inputs, tile=tile, w=P.PALLAS_JAC_WINDOW)
 
         def check(res):
             res = np.asarray(res)
@@ -618,7 +621,14 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="1,2,3,4,5,6")
     ap.add_argument("--seconds", type=float, default=8.0)
+    ap.add_argument("--require-tpu", action="store_true",
+                    help="exit 3 unless the real chip answers the probe "
+                         "(tpu_watch queue gating)")
     args = ap.parse_args()
+    if args.require_tpu and _platform() in ("cpu", "hung"):
+        print(json.dumps({"error": f"--require-tpu: platform={_platform()}"}),
+              flush=True)
+        return 3
 
     from upow_tpu import compile_cache
 
@@ -638,12 +648,14 @@ def main() -> int:
         "9": lambda: config9_sync(args.seconds),
     }
     needs_device = {"2", "3", "5", "7"}
+    failed = []
     for key in args.configs.split(","):
         key = key.strip()
         if key in needs_device and _platform() == "hung":
             print(json.dumps({
                 "metric": f"config{key}_error", "value": 0.0, "unit": "",
                 "vs_baseline": 0.0, "error": "jax backend hung"}), flush=True)
+            failed.append(key)
             continue
         try:
             runners[key]()
@@ -652,7 +664,11 @@ def main() -> int:
                 "metric": f"config{key}_error", "value": 0.0, "unit": "",
                 "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"[:200],
             }), flush=True)
-    return 0
+            failed.append(key)
+    # under --require-tpu a config that produced no numbers must fail the
+    # run, or tpu_watch would mark the queue step done with nothing
+    # measured (rc semantics mirror tpu_ab's all-cells-or-nonzero)
+    return 3 if (args.require_tpu and failed) else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,168 @@
+"""TPU tunnel watcher + armed bench queue (VERDICT r4, item 1).
+
+Probes the tunneled chip from a disposable subprocess on a fixed
+cadence; the moment a probe answers, runs the full measurement queue —
+bench.py x3 (search + verify snapshots with history spread), the
+window/tile A/B matrix (tpu_ab.py), and bench_suite configs 3,5,7 —
+each step its own process group with a hard deadline.
+
+Hard-won tunnel rules encoded here (rounds 2-5):
+  * ONE client at a time.  A probe launched while another client is
+    attached wedges BOTH, and the wedge can outlive the clients.
+  * A stuck PJRT call cannot be interrupted — only kill -9 of the whole
+    process group reclaims anything.
+  * After a kill, let the tunnel idle before the next attempt.
+
+State: .tpu_queue_state.json records the furthest completed step, so a
+mid-queue wedge resumes where it left off instead of re-burning chip
+time.  Log: tpu_watch.log.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LOG = os.path.join(_HERE, "tpu_watch.log")
+_STATE = os.path.join(_HERE, ".tpu_queue_state.json")
+
+_PROBE_TIMEOUT = 110.0
+_PROBE_GAP = 330.0          # idle between failed probes (tunnel cooldown)
+_POST_KILL_GAP = 60.0       # idle after killing a wedged step
+
+# (name, argv, deadline_s).  bench.py runs three times so the history
+# file carries n>=3 samples for the spread convention.  --require-tpu:
+# a CPU fallback exits 3 instead of 0, so a queue step can never be
+# marked done on a host-only number.
+_QUEUE = [
+    ("bench1", [sys.executable, "bench.py", "--seconds", "10",
+                "--require-tpu"], 900),
+    ("bench2", [sys.executable, "bench.py", "--seconds", "10",
+                "--require-tpu"], 600),
+    ("bench3", [sys.executable, "bench.py", "--seconds", "10",
+                "--require-tpu"], 600),
+    ("ab_matrix", [sys.executable, "tpu_ab.py", "--seconds", "6"], 2400),
+    ("suite_357", [sys.executable, "bench_suite.py", "--configs", "3,5,7",
+                   "--require-tpu"], 1500),
+]
+
+
+def _log(msg: str) -> None:
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    with open(_LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def _load_state() -> dict:
+    try:
+        with open(_STATE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"done": []}
+
+
+def _save_state(state: dict) -> None:
+    tmp = _STATE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+    os.replace(tmp, _STATE)
+
+
+def _run_grouped(argv, deadline: float, log_name: str) -> int:
+    """Run argv in its own session; kill -9 the whole group on deadline.
+    Output streams to tpu_watch.log so partial progress survives."""
+    with open(_LOG, "a") as logf:
+        logf.write(f"--- {log_name}: {' '.join(argv)}\n")
+        logf.flush()
+        proc = subprocess.Popen(argv, cwd=_HERE, stdout=logf,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        try:
+            return proc.wait(timeout=deadline)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            proc.wait()
+            return -9
+
+
+def _probe() -> bool:
+    """True iff a fresh subprocess sees a non-cpu jax backend in time."""
+    code = ("import jax\n"
+            "print('PLATFORM=' + jax.devices()[0].platform, flush=True)\n")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=_PROBE_TIMEOUT, start_new_session=True)
+    except subprocess.TimeoutExpired:
+        _log(f"probe: timeout after {_PROBE_TIMEOUT:.0f}s (tunnel wedged)")
+        return False
+    for line in (proc.stdout or "").splitlines():
+        if line.startswith("PLATFORM="):
+            plat = line.split("=", 1)[1]
+            _log(f"probe: platform={plat}")
+            return plat not in ("cpu",)
+    _log(f"probe: no platform line (rc={proc.returncode})")
+    return False
+
+
+_MAX_ATTEMPTS = 4  # per step; a deterministic failure must not loop forever
+
+
+def main() -> int:
+    one_shot = "--once" in sys.argv
+    if "--reset" in sys.argv:  # fresh measurement campaign
+        try:
+            os.remove(_STATE)
+        except OSError:
+            pass
+    state = _load_state()
+    state.setdefault("attempts", {})
+    _log(f"watcher up (pid {os.getpid()}), done={state['done']}")
+    while True:
+        pending = [(n, a, d) for n, a, d in _QUEUE
+                   if n not in state["done"]
+                   and state["attempts"].get(n, 0) < _MAX_ATTEMPTS]
+        if not pending:
+            exhausted = [n for n, *_ in _QUEUE if n not in state["done"]]
+            _log(f"queue complete; exhausted={exhausted}; exiting")
+            return 0 if not exhausted else 2
+        if _probe():
+            step_failed = False
+            for name, argv, deadline in pending:
+                t0 = time.time()
+                state["attempts"][name] = state["attempts"].get(name, 0) + 1
+                _save_state(state)
+                rc = _run_grouped(argv, deadline, name)
+                wall = round(time.time() - t0, 1)
+                if rc == 0:
+                    _log(f"{name}: OK in {wall}s")
+                    state["done"].append(name)
+                    _save_state(state)
+                else:
+                    _log(f"{name}: rc={rc} after {wall}s "
+                         f"(attempt {state['attempts'][name]}/"
+                         f"{_MAX_ATTEMPTS}); re-probing before retry")
+                    step_failed = True
+                    break  # back to the probe loop; resume from here
+            if not step_failed:
+                continue  # whole queue drained: exit now, don't linger
+            if one_shot:
+                return 1
+            # short cooldown then straight back to the probe — the long
+            # probe gap is for a dead tunnel, not a failed step
+            time.sleep(_POST_KILL_GAP)
+            continue
+        if one_shot:
+            return 1
+        time.sleep(_PROBE_GAP)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

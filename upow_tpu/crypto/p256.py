@@ -1160,8 +1160,35 @@ PALLAS_KERNEL = "jac"
 # Jacobian ladder window bits.  w=4: 64 rounds, 16-entry tables.  w=5:
 # 52 rounds (fewer adds/tests per bit) but 32-entry tables (pricier
 # picks/setup) — measured A/B on the chip decides; both are covered by
-# the eager-twin differentials.
-PALLAS_JAC_WINDOW = 4
+# the eager-twin differentials.  UPOW_JAC_WINDOW overrides, so the
+# chip-window A/B harness (tpu_ab.py) can flip it per-subprocess
+# without editing source mid-queue.
+
+
+def _env_choice(name: str, default: int, allowed) -> int:
+    """Env-knob parse that can't take down an importer: only the
+    differential-covered values are accepted; anything else (typo,
+    stray export, untested window) logs and falls back to the default —
+    a consensus node must not boot into an unvetted kernel config."""
+    import logging
+    import os
+
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = int(raw.strip())
+    except ValueError:
+        val = None
+    if val not in allowed:
+        logging.getLogger("upow_tpu.crypto").warning(
+            "%s=%r invalid (allowed %s); using %d", name, raw,
+            sorted(allowed), default)
+        return default
+    return val
+
+
+PALLAS_JAC_WINDOW = _env_choice("UPOW_JAC_WINDOW", 4, {4, 5})
 
 
 def _pallas_or_jnp(pallas_thunk, jnp_thunk) -> np.ndarray:
@@ -1182,9 +1209,15 @@ def _pallas_or_jnp(pallas_thunk, jnp_thunk) -> np.ndarray:
         return np.asarray(jnp_thunk())
 
 
-def _pick_tile(padded: int, cap: int = 1024) -> int:
+# tile caps: 128-multiples that divide the 8192-lane bench/production
+# pad shapes; the sweep only needs these three
+_TILE_CAP = _env_choice("UPOW_TILE_CAP", 1024, {128, 256, 512, 1024})
+
+
+def _pick_tile(padded: int, cap: int = _TILE_CAP) -> int:
     """Largest 128-multiple divisor of ``padded`` that is <= ``cap``
-    (``padded`` is always a multiple of 128 on the pallas path)."""
+    (``padded`` is always a multiple of 128 on the pallas path;
+    UPOW_TILE_CAP overrides the default 1024 for the chip tile sweep)."""
     rows = padded // 128
     for k in range(min(cap // 128, rows), 0, -1):
         if rows % k == 0:
