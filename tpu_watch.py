@@ -93,17 +93,27 @@ def _run_grouped(argv, deadline: float, log_name: str) -> int:
 
 
 def _probe() -> bool:
-    """True iff a fresh subprocess sees a non-cpu jax backend in time."""
+    """True iff a fresh subprocess sees a non-cpu jax backend in time.
+
+    Popen + killpg (same as _run_grouped / tpu_ab): a wedged PJRT
+    client can leave session members holding the stdout pipe, and
+    subprocess.run's post-timeout drain would block on them forever."""
     code = ("import jax\n"
             "print('PLATFORM=' + jax.devices()[0].platform, flush=True)\n")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code], stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, start_new_session=True)
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=_PROBE_TIMEOUT, start_new_session=True)
+        out, _ = proc.communicate(timeout=_PROBE_TIMEOUT)
     except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.communicate()
         _log(f"probe: timeout after {_PROBE_TIMEOUT:.0f}s (tunnel wedged)")
         return False
-    for line in (proc.stdout or "").splitlines():
+    for line in (out or "").splitlines():
         if line.startswith("PLATFORM="):
             plat = line.split("=", 1)[1]
             _log(f"probe: platform={plat}")
@@ -137,8 +147,6 @@ def main() -> int:
             step_failed = False
             for name, argv, deadline in pending:
                 t0 = time.time()
-                state["attempts"][name] = state["attempts"].get(name, 0) + 1
-                _save_state(state)
                 rc = _run_grouped(argv, deadline, name)
                 wall = round(time.time() - t0, 1)
                 if rc == 0:
@@ -146,8 +154,16 @@ def main() -> int:
                     state["done"].append(name)
                     _save_state(state)
                 else:
+                    # only DETERMINISTIC failures (rc > 0) consume the
+                    # attempt budget; a deadline kill (rc < 0) is the
+                    # environmental wedge this watcher exists to outlive
+                    # and may recur any number of times
+                    if rc > 0:
+                        state["attempts"][name] = (
+                            state["attempts"].get(name, 0) + 1)
+                        _save_state(state)
                     _log(f"{name}: rc={rc} after {wall}s "
-                         f"(attempt {state['attempts'][name]}/"
+                         f"(attempt {state['attempts'].get(name, 0)}/"
                          f"{_MAX_ATTEMPTS}); re-probing before retry")
                     step_failed = True
                     break  # back to the probe loop; resume from here

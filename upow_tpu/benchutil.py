@@ -48,12 +48,24 @@ def boxed_call(fn, timeout: float):
     return "timeout", None
 
 
+# Platform strings that mean "a real TPU answers": native libtpu
+# reports "tpu"; the axon tunnel plugin registers its PJRT client under
+# "axon" and only aliases the MLIR lowering tables to tpu's, so
+# Device.platform / jax.default_backend() can read "axon" on the very
+# hardware all the == "tpu" routing was written for.
+TPU_PLATFORMS = ("tpu", "axon")
+
+
 def probe_platform(timeout: float = 90.0) -> Optional[str]:
-    """Platform string of jax.devices()[0]; None if init hung or failed."""
+    """Platform string of jax.devices()[0]; None if init hung or failed.
+    TPU-class platform aliases (axon tunnel) normalize to "tpu" so every
+    downstream backend-routing comparison sees one canonical name."""
     import jax
 
     status, value = boxed_call(lambda: jax.devices()[0].platform, timeout)
-    return value if status == "ok" else None
+    if status != "ok":
+        return None
+    return "tpu" if value in TPU_PLATFORMS else value
 
 
 _PROBE_CACHE: dict = {}

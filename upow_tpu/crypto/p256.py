@@ -1393,10 +1393,14 @@ def verify_batch_prehashed(
         pad_block = pad_block * n_dev // math.gcd(pad_block, n_dev)
     if n == 0:
         return np.zeros(0, dtype=bool)
+    # "axon" = the tunnel plugin's PJRT client name for the same TPU
+    # hardware (lowering tables are aliased to tpu's) — route it like tpu
     if backend is None:
-        backend = "pallas" if jax.default_backend() == "tpu" else "jnp"
+        backend = ("pallas" if jax.default_backend() in ("tpu", "axon")
+                   else "jnp")
     if scalar_prep is None:
-        scalar_prep = "device" if jax.default_backend() == "tpu" else "host"
+        scalar_prep = ("device" if jax.default_backend() in ("tpu", "axon")
+                       else "host")
     if mesh is not None and backend == "pallas":
         if PALLAS_KERNEL != "jac" or scalar_prep != "device":
             raise ValueError(
