@@ -31,7 +31,16 @@ _STATE = os.path.join(_HERE, ".tpu_queue_state.json")
 
 _PROBE_TIMEOUT = 110.0
 _PROBE_GAP = 330.0          # idle between failed probes (tunnel cooldown)
+_PROBE_GAP_MAX = 1800.0     # backoff ceiling (see below)
 _POST_KILL_GAP = 60.0       # idle after killing a wedged step
+
+# Backoff rationale: round 4 probed every ~6.5 min for 9 h with ZERO
+# recoveries, yet this session's FIRST touch after a long quiet period
+# succeeded instantly — the evidence is consistent with each timed-out
+# probe itself re-wedging the server-side claim.  So the gap doubles
+# every 4 consecutive failures (5.5 -> 11 -> 22 -> 30 min cap), giving
+# the tunnel genuinely quiet windows while still noticing recovery
+# within half an hour.
 
 # (name, argv, deadline_s).  bench.py runs three times so the history
 # file carries n>=3 samples for the spread convention.  --require-tpu:
@@ -135,6 +144,7 @@ def main() -> int:
     state = _load_state()
     state.setdefault("attempts", {})
     _log(f"watcher up (pid {os.getpid()}), done={state['done']}")
+    probe_failures = 0
     while True:
         pending = [(n, a, d) for n, a, d in _QUEUE
                    if n not in state["done"]
@@ -144,6 +154,7 @@ def main() -> int:
             _log(f"queue complete; exhausted={exhausted}; exiting")
             return 0 if not exhausted else 2
         if _probe():
+            probe_failures = 0
             step_failed = False
             for name, argv, deadline in pending:
                 t0 = time.time()
@@ -177,7 +188,12 @@ def main() -> int:
             continue
         if one_shot:
             return 1
-        time.sleep(_PROBE_GAP)
+        probe_failures += 1
+        gap = min(_PROBE_GAP * (2 ** (probe_failures // 4)), _PROBE_GAP_MAX)
+        if probe_failures % 4 == 0:
+            _log(f"probe backoff: {probe_failures} consecutive failures, "
+                 f"gap now {gap:.0f}s")
+        time.sleep(gap)
 
 
 if __name__ == "__main__":
