@@ -1,0 +1,23 @@
+PYTHON ?= python
+
+.PHONY: lint test ruff
+
+# Domain linter: consensus-endianness, consensus-purity, jit-purity,
+# dtype-hygiene, async-safety, broad-except.  Stdlib-only; exits 1 on
+# any unsuppressed error.
+lint:
+	$(PYTHON) -m upow_tpu.lint upow_tpu/
+	@$(MAKE) --no-print-directory ruff
+
+# Generic baseline (ruff.toml); skipped quietly where ruff is not
+# installed — the container bakes no ruff and we don't pip install.
+ruff:
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check upow_tpu/; \
+	else \
+		echo "ruff not installed; skipping generic baseline"; \
+	fi
+
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider

@@ -1,0 +1,23 @@
+"""CE fixture: lives under a ``core/`` directory so the scoped endianness
+rules apply.  Never imported — parsed by upowlint only."""
+
+
+def encode(value: int) -> bytes:
+    return value.to_bytes(4, "big")          # CE001 fires here
+
+
+def decode(raw: bytes) -> int:
+    return int.from_bytes(raw, byteorder="big")   # CE001 via keyword
+
+
+def encode_bare(value: int) -> bytes:
+    return value.to_bytes(4)                 # CE002: bare byteorder
+
+
+def encode_suppressed(value: int) -> bytes:
+    # fixture: suppression must hide this from findings
+    return value.to_bytes(4, "big")  # upowlint: disable=CE001
+
+
+def encode_ok(value: int) -> bytes:
+    return value.to_bytes(4, "little")       # no finding

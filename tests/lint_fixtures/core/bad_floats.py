@@ -1,0 +1,42 @@
+"""CP fixture: consensus-purity violations under a ``core/`` directory."""
+
+import time
+from datetime import datetime, timezone
+from decimal import Decimal
+
+
+def half(reward):
+    return reward * Decimal(0.5)             # CP001: float literal
+
+
+def stamp() -> int:
+    return int(time.time())                  # CP002: wall clock
+
+
+def stamp2():
+    return datetime.now(timezone.utc)        # CP002: wall clock
+
+
+def apply_all(entries):
+    total = 0
+    for entry in set(entries):               # CP003: set iteration
+        total += entry
+    return total
+
+
+def ratio(difficulty):
+    return float(difficulty) * 10            # CP004: float() conversion
+
+
+def half_suppressed(reward):
+    # fixture: justified suppression must be honored
+    return reward * Decimal(0.5)  # upowlint: disable=CP001
+
+
+def elapsed(t0):
+    return time.monotonic() - t0             # no finding: monotonic is fine
+
+
+def ordered(entries):
+    # no finding: the iterable is sorted(...), which fixes the order
+    return [e for e in sorted(set(entries))]

@@ -1,0 +1,33 @@
+"""DT fixture: dtype-hygiene violations under a ``crypto/`` directory."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def widen(x):
+    return x.astype(np.int64)                # DT001: 64-bit dtype
+
+
+def mixed(a, b):
+    return jnp.uint32(a) + jnp.int32(b)      # DT002: mixed-dtype binop
+
+
+def overflow():
+    return jnp.uint32(2 ** 40)               # DT003: does not fit
+
+
+def negative_unsigned():
+    return jnp.uint32(-1)                    # DT003: wraps
+
+
+def widen_suppressed(x):
+    # fixture: host-side conversion, justified
+    return x.astype(np.int64)  # upowlint: disable=DT001
+
+
+def fits():
+    return jnp.uint32(2 ** 32 - 1)           # no finding: in range
+
+
+def same(a, b):
+    return jnp.uint32(a) + jnp.uint32(b)     # no finding: same dtype

@@ -1,0 +1,68 @@
+"""JP fixture: jit-purity violations and must-NOT-fire patterns.
+
+Never imported (jax references are only parsed), so this file carries no
+runtime dependency on jax.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def branch_on_traced(x):
+    if x > 0:                                # JP001: Python if on tracer
+        return x
+    return -x
+
+
+@jax.jit
+def host_sync(x):
+    v = x.item()                             # JP002: blocking transfer
+    return float(x) + v                      # JP002 (and CP004: crypto scope)
+
+
+@jax.jit
+def np_sync(x):
+    return np.asarray(x)                     # JP002: host materialization
+
+
+@jax.jit
+def staged_const(x):
+    table = jnp.array([1, 2, 3])             # JP003 (warning)
+    return x + table
+
+
+@partial(jax.jit, static_argnames=("n",))
+def static_branch(x, n):
+    if n > 4:                                # no finding: n is static
+        return x * 2
+    return x
+
+
+@jax.jit
+def shape_assert(q):
+    n = q.shape[1]
+    assert n % 128 == 0                      # no finding: shape-derived
+    return q.sum()
+
+
+@jax.jit
+def assert_on_traced(x):
+    assert x.sum() > 0                       # JP001: assert on tracer
+    return x
+
+
+@jax.jit
+def suppressed_branch(x):
+    if x > 0:  # fixture suppression  # upowlint: disable=JP001
+        return x
+    return -x
+
+
+def plain_helper(x):
+    if x > 0:                                # no finding: not jitted
+        return x
+    return -x

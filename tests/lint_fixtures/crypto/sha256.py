@@ -1,0 +1,11 @@
+"""CE allowlist fixture: path ends with ``crypto/sha256.py``, so the
+endianness rules must skip this file entirely (FIPS 180-4 mandates
+big-endian)."""
+
+
+def pad_length(bit_len: int) -> bytes:
+    return bit_len.to_bytes(8, "big")        # allowlisted: no CE001
+
+
+def word(raw: bytes) -> int:
+    return int.from_bytes(raw)               # allowlisted: no CE002

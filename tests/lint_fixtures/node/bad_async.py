@@ -1,0 +1,29 @@
+"""AS fixture: blocking calls in async code under a ``node/`` directory."""
+
+import subprocess
+import time
+
+import requests
+
+
+async def poll():
+    time.sleep(5)                            # AS001: blocks the loop
+    return requests.get("http://peer/info")  # AS001: sync HTTP
+
+
+async def shell_out():
+    return subprocess.run(["true"])          # AS001: sync subprocess
+
+
+async def suppressed():
+    time.sleep(0)  # fixture suppression  # upowlint: disable=AS001
+
+
+async def fine():
+    import asyncio
+
+    await asyncio.sleep(5)                   # no finding
+
+
+def sync_helper():
+    time.sleep(1)                            # no finding: not async

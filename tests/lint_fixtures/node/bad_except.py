@@ -1,0 +1,52 @@
+"""BE fixture: broad-except handling under a ``node/`` directory (the
+rule itself is unscoped; the directory just keeps fixtures tidy)."""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def swallow():
+    try:
+        risky()
+    except Exception:                        # BE001: silent swallow
+        pass
+
+
+def swallow_bare():
+    try:
+        risky()
+    except:                                  # noqa: E722  BE001: bare except
+        return None
+
+
+def logged():
+    try:
+        risky()
+    except Exception as e:                   # no finding: logged
+        log.warning("risky failed: %s", e)
+
+
+def reraised():
+    try:
+        risky()
+    except Exception:                        # no finding: re-raised
+        raise
+
+
+def boxed(box):
+    try:
+        risky()
+    except Exception as e:                   # no finding: captured for caller
+        box["err"] = e
+
+
+def suppressed():
+    try:
+        risky()
+    except Exception:  # fixture suppression  # upowlint: disable=BE001
+        pass
+
+
+def risky():
+    raise RuntimeError("boom")

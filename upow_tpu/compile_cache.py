@@ -23,6 +23,7 @@ not evidence of it.
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 import platform
 
@@ -50,8 +51,9 @@ def _gcc_native_march() -> str:
         for line in (out.stderr + out.stdout).splitlines():
             if "-march=" in line:
                 return line[line.index("-march="):].strip()
-    except Exception:
-        pass
+    except Exception as e:
+        logging.getLogger("upow_tpu.compile_cache").debug(
+            "gcc -march=native probe failed: %s", e)
     return "gcc-unavailable"
 
 
@@ -107,7 +109,9 @@ def enable(cache_root: str) -> str:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
         return path
-    except Exception:
+    except Exception as e:
+        logging.getLogger("upow_tpu.compile_cache").warning(
+            "could not enable persistent compile cache at %s: %s", path, e)
         return ""
 
 

@@ -9,7 +9,6 @@ from __future__ import annotations
 import hashlib
 from enum import Enum, IntEnum
 from functools import lru_cache
-from math import ceil
 from typing import Tuple, Union
 
 from .constants import CURVE_A, CURVE_B, CURVE_P, ENDIAN
@@ -33,8 +32,12 @@ def sha256_bytes(message: Union[str, bytes]) -> bytes:
 
 
 def byte_length(i: int) -> int:
-    """Minimum bytes to hold ``i`` (helpers.py:47-48)."""
-    return ceil(i.bit_length() / 8.0)
+    """Minimum bytes to hold ``i`` (helpers.py:47-48).
+
+    Pure-int ceil-div; identical to the reference's ceil(bits / 8.0) for
+    every non-negative int (float division is exact up to 2**52 bits).
+    """
+    return (i.bit_length() + 7) // 8
 
 
 # --- base58 (Bitcoin alphabet) ------------------------------------------
@@ -44,7 +47,9 @@ _B58_INDEX = {c: i for i, c in enumerate(_B58_ALPHABET)}
 
 
 def b58encode(data: bytes) -> str:
-    n = int.from_bytes(data, "big")
+    # base58 treats the payload as one big-endian bigint by convention
+    # (Bitcoin's encoding); this is not uPow wire-format serialization.
+    n = int.from_bytes(data, "big")  # upowlint: disable=CE001
     out = []
     while n:
         n, r = divmod(n, 58)
@@ -65,7 +70,8 @@ def b58decode(s: str) -> bytes:
             n = n * 58 + _B58_INDEX[c]
         except KeyError:
             raise ValueError(f"invalid base58 character {c!r}")
-    body = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    # Inverse of b58encode's big-endian bigint convention (see above).
+    body = n.to_bytes((n.bit_length() + 7) // 8, "big")  # upowlint: disable=CE001
     pad = 0
     for c in s:
         if c == "1":

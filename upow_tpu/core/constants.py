@@ -14,7 +14,9 @@ ENDIAN = "little"
 # everywhere except the Decimal-sensitive inode reward split.
 SMALLEST = 100_000_000
 
-MAX_SUPPLY = 18_884_643.75  # constants.py:6
+# Float literal is reference-faithful (constants.py:6); .75 is exactly
+# representable, and every consumer goes through Decimal/str first.
+MAX_SUPPLY = 18_884_643.75  # upowlint: disable=CP001
 VERSION = 2  # tx version (constants.py:7)
 MAX_BLOCK_SIZE_HEX = 4096 * 1024  # 4 MB hex == 2 MB raw (constants.py:8)
 MAX_INODES = 12  # constants.py:9

@@ -73,7 +73,11 @@ def block_to_bytes(last_block_hash: str, block: dict) -> bytes:
         address=block["address"],
         merkle_root=block["merkle_tree"],
         timestamp=int(block["timestamp"]),
-        difficulty_x10=int(float(block["difficulty"]) * 10),
+        # Exact Decimal path; agrees with the reference's
+        # int(float(d) * 10) for every representable difficulty (the wire
+        # field is x10 in [0, 65535], all round-trip exact — verified by
+        # tests/test_lint.py::test_difficulty_x10_decimal_matches_float).
+        difficulty_x10=int(Decimal(str(block["difficulty"])) * 10),
         nonce=block["random"],
     ).tobytes()
 

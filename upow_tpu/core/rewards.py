@@ -68,8 +68,11 @@ def get_inode_rewards(
     total_percent = sum(entry["emission"] for entry in inode_address_details)
     if not inode_address_details or total_percent <= 0:
         return reward, {}
-    miner_reward = reward * Decimal(0.5)
-    distribution_reward = reward * Decimal(0.5)
+    # Decimal("0.5") == Decimal(0.5) exactly (0.5 is a power of two), so
+    # this stays bit-identical to the reference while keeping the module
+    # free of float literals.
+    miner_reward = reward * Decimal("0.5")
+    distribution_reward = reward * Decimal("0.5")
     distributed_rewards: Dict[str, Decimal] = {}
     redistribution_reward = Decimal(0)
 

@@ -95,7 +95,9 @@ def ints_to_limbs(xs) -> np.ndarray:
 
 
 def limbs_to_int(limbs) -> int:
-    limbs = np.asarray(limbs, dtype=np.int64)
+    # Host-side exact reassembly of a 256-bit value from limbs; int64
+    # never reaches a traced computation.
+    limbs = np.asarray(limbs, dtype=np.int64)  # upowlint: disable=DT001
     return sum(int(limbs[i]) << (LIMB_BITS * i) for i in range(limbs.shape[0]))
 
 

@@ -68,7 +68,9 @@ def _rotr_py(x: int, n: int) -> int:
 
 def _compress_py(state: Sequence[int], block: bytes) -> Tuple[int, ...]:
     """One SHA-256 compression on the host (64-byte block)."""
-    w = list(np.frombuffer(block, dtype=">u4").astype(np.uint64))
+    # Host-only midstate prep (never traced); uint64 gives headroom for
+    # the Python-int schedule additions below.
+    w = list(np.frombuffer(block, dtype=">u4").astype(np.uint64))  # upowlint: disable=DT001
     w = [int(x) for x in w]
     for i in range(16, 64):
         s0 = _rotr_py(w[i - 15], 7) ^ _rotr_py(w[i - 15], 18) ^ (w[i - 15] >> 3)
@@ -493,7 +495,8 @@ def _measure_txid_crossover(payloads, host_fn):
     from ..benchutil import boxed_call, probed_platform_cached
 
     log = logging.getLogger("upow_tpu.crypto")
-    if probed_platform_cached(timeout=90.0) in (None, "cpu"):
+    # Operational timeouts/timing below are not consensus data.
+    if probed_platform_cached(timeout=90.0) in (None, "cpu"):  # upowlint: disable=CP001
         log.info("txid auto: no accelerator; host hashing")
         return "host", None
     t0 = _t.perf_counter()
@@ -503,19 +506,19 @@ def _measure_txid_crossover(payloads, host_fn):
     def device_once():
         return sha256_batch_jnp(payloads)
 
-    status, _ = boxed_call(device_once, timeout=240.0)  # compile warmup
+    status, _ = boxed_call(device_once, timeout=240.0)  # compile warmup  # upowlint: disable=CP001
     if status != "ok":
         log.warning("txid auto: device probe %s; host hashing", status)
         return "host", host_digests
     t0 = _t.perf_counter()
-    status, _ = boxed_call(device_once, timeout=60.0)
+    status, _ = boxed_call(device_once, timeout=60.0)  # upowlint: disable=CP001
     t_dev = _t.perf_counter() - t0
     if status != "ok":
         log.warning("txid auto: device re-run %s; host hashing", status)
         return "host", host_digests
     choice = "device" if t_dev < t_host else "host"
     log.info("txid auto: host %.1fms vs device %.1fms for %d payloads -> %s",
-             t_host * 1e3, t_dev * 1e3, len(payloads), choice)
+             t_host * 1e3, t_dev * 1e3, len(payloads), choice)  # upowlint: disable=CP001
     # either way the verified-correct host digests serve this batch
     return choice, host_digests
 

@@ -1,0 +1,16 @@
+"""upowlint — AST-based consensus-safety and JAX-purity checks.
+
+Run as ``python -m upow_tpu.lint [paths] [--format json]``; exits 1 when
+any error-severity finding survives suppression.  See
+docs/STATIC_ANALYSIS.md for the rule catalogue and the reasoning behind
+each family.
+
+This subpackage must stay importable without jax installed — CI's lint
+job and pre-commit hooks run it in bare environments.
+"""
+
+from .engine import (Finding, LintResult, SEVERITY_ERROR, SEVERITY_WARNING,
+                     run_lint)
+
+__all__ = ["Finding", "LintResult", "SEVERITY_ERROR", "SEVERITY_WARNING",
+           "run_lint"]

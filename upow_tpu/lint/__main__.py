@@ -1,0 +1,46 @@
+"""CLI entry point: ``python -m upow_tpu.lint [paths ...]``."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .engine import run_lint
+from .rules import ALL_RULES
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m upow_tpu.lint",
+        description="upowlint: consensus-safety & JAX-purity static analysis")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (default: the upow_tpu package)")
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (json includes suppressed findings)")
+    parser.add_argument(
+        "--select", default=None, metavar="RULES",
+        help="comma-separated rule ids to run (e.g. CE001,JP001)")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalogue and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.rule_id}  [{rule.severity:7s}]  {rule.description}")
+        return 0
+
+    paths = args.paths or [str(Path(__file__).resolve().parent.parent)]
+    select = None
+    if args.select:
+        select = {r.strip() for r in args.select.split(",") if r.strip()}
+    result = run_lint(paths, select=select)
+    print(result.to_json() if args.format == "json" else result.to_text())
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

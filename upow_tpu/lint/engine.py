@@ -1,0 +1,249 @@
+"""upowlint engine: file discovery, suppression parsing, rule running.
+
+Deliberately dependency-free (stdlib ``ast`` only) and independent of the
+rest of the package — ``python -m upow_tpu.lint`` must start fast and run
+in environments without jax (CI's lint job, pre-commit hooks).
+
+Rule protocol
+-------------
+A rule is an object with:
+
+* ``rule_id``     — short code, e.g. ``"CE001"`` (family prefix + number).
+* ``severity``    — ``"error"`` or ``"warning"``; only errors gate exit 0.
+* ``description`` — one line, shown by ``--list-rules``.
+* ``scope(parts)``— predicate over the file's path parts (package-relative
+  when inside ``upow_tpu/``); limits domain rules to the layers where the
+  invariant they police actually holds (e.g. consensus purity only inside
+  ``core``/``crypto``/``verify``).
+* ``check(ctx)``  — yields ``(line, col, message)`` tuples (the engine
+  attaches path/rule/severity and applies suppressions).
+
+Suppression
+-----------
+``# upowlint: disable=CE001`` (comma-separated list, or ``all``) on the
+line a finding is reported at suppresses it.  Every suppression in the
+tree is expected to carry a justification in the same comment or the line
+above — that convention is reviewed, not machine-enforced.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+_SUPPRESS_RE = re.compile(r"#\s*upowlint:\s*disable=([A-Za-z0-9_*,\s]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    severity: str
+    message: str
+
+    def as_dict(self) -> dict:
+        return {
+            "path": self.path, "line": self.line, "col": self.col,
+            "rule": self.rule, "severity": self.severity,
+            "message": self.message,
+        }
+
+
+@dataclass
+class FileContext:
+    """Everything a rule gets to look at for one file."""
+
+    path: Path                 # as discovered
+    rel: str                   # posix path relative to the lint root
+    parts: Tuple[str, ...]     # rel split into components
+    tree: ast.Module
+    source: str
+    lines: List[str] = field(default_factory=list)
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]
+    suppressed: List[Finding]
+    files_scanned: int
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == SEVERITY_ERROR]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == SEVERITY_WARNING]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.errors else 0
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "files_scanned": self.files_scanned,
+            "counts": {
+                "error": len(self.errors),
+                "warning": len(self.warnings),
+                "suppressed": len(self.suppressed),
+            },
+            "findings": [f.as_dict() for f in self.findings],
+            "suppressed": [f.as_dict() for f in self.suppressed],
+        }, indent=2)
+
+    def to_text(self) -> str:
+        out = [
+            f"{f.path}:{f.line}:{f.col}: {f.rule} [{f.severity}] {f.message}"
+            for f in self.findings
+        ]
+        out.append(
+            f"upowlint: {len(self.errors)} error(s), "
+            f"{len(self.warnings)} warning(s), "
+            f"{len(self.suppressed)} suppressed, "
+            f"{self.files_scanned} file(s) scanned")
+        return "\n".join(out)
+
+
+def _package_root() -> Path:
+    """Directory that CONTAINS the upow_tpu package (repo root in-tree)."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def relative_parts(path: Path) -> Tuple[str, Tuple[str, ...]]:
+    """Path components used for rule scoping.
+
+    Files inside the ``upow_tpu`` package are keyed package-relative
+    (``core/tx.py``); anything else (test fixtures, scripts) falls back to
+    the path relative to the cwd, or its absolute components.  Scoping is
+    by directory NAME (``"core" in parts``), so fixture trees like
+    ``tests/lint_fixtures/core/x.py`` land in the same scope as the real
+    module — that is what lets the test suite exercise scoped rules.
+    """
+    resolved = path.resolve()
+    for anchor in (_package_root() / "upow_tpu", Path.cwd()):
+        try:
+            rel = resolved.relative_to(anchor.resolve())
+            return rel.as_posix(), rel.parts
+        except ValueError:
+            continue
+    return resolved.as_posix(), resolved.parts
+
+
+def discover(paths: Sequence[str]) -> List[Path]:
+    files: List[Path] = []
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            files.extend(sorted(path.rglob("*.py")))
+        elif path.suffix == ".py":
+            files.append(path)
+    # dedupe preserving order
+    seen: Set[Path] = set()
+    out = []
+    for f in files:
+        r = f.resolve()
+        if r not in seen and "__pycache__" not in f.parts:
+            seen.add(r)
+            out.append(f)
+    return out
+
+
+def parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    """line -> set of rule ids disabled on that line ('*' disables all).
+
+    Tokenize-based so a ``# upowlint:`` inside a string literal is not
+    honored; falls back to a line scan if tokenization fails.
+    """
+    out: Dict[int, Set[str]] = {}
+
+    def record(lineno: int, spec: str) -> None:
+        rules = {r.strip() for r in spec.split(",") if r.strip()}
+        if "all" in rules:
+            rules = {"*"}
+        out.setdefault(lineno, set()).update(rules)
+
+    try:
+        import io
+
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                m = _SUPPRESS_RE.search(tok.string)
+                if m:
+                    record(tok.start[0], m.group(1))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for i, line in enumerate(source.splitlines(), 1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                record(i, m.group(1))
+    return out
+
+
+def run_lint(paths: Sequence[str], rules: Optional[Sequence] = None,
+             select: Optional[Set[str]] = None) -> LintResult:
+    """Run ``rules`` (default: the full registry) over ``paths``."""
+    if rules is None:
+        from .rules import ALL_RULES
+
+        rules = ALL_RULES
+    if select:
+        rules = [r for r in rules if r.rule_id in select]
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    files = discover(paths)
+    for path in files:
+        try:
+            source = path.read_text(encoding="utf-8")
+            tree = ast.parse(source, filename=str(path))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            findings.append(Finding(
+                str(path), getattr(e, "lineno", 1) or 1, 0, "LINT000",
+                SEVERITY_ERROR, f"file does not parse: {e.msg if hasattr(e, 'msg') else e}"))
+            continue
+        rel, parts = relative_parts(path)
+        ctx = FileContext(path=path, rel=rel, parts=parts, tree=tree,
+                          source=source, lines=source.splitlines())
+        per_line = parse_suppressions(source)
+        for rule in rules:
+            if not rule.scope(parts):
+                continue
+            for line, col, message in rule.check(ctx):
+                f = Finding(str(path), line, col, rule.rule_id,
+                            rule.severity, message)
+                disabled = per_line.get(line, set())
+                if "*" in disabled or rule.rule_id in disabled:
+                    suppressed.append(f)
+                else:
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintResult(findings=findings, suppressed=suppressed,
+                      files_scanned=len(files))
+
+
+# --- shared AST helpers used by several rule modules ----------------------
+
+def dotted_name(node: ast.AST) -> str:
+    """'time.time' for Attribute/Name chains, '' when not a plain chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def iter_function_defs(tree: ast.AST) -> Iterable[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node

@@ -1,0 +1,15 @@
+"""Rule registry. Import order fixes the --list-rules display order."""
+
+from . import (asyncsafety, broadexcept, consensus, dtypes, endianness,
+               jitpurity)
+
+ALL_RULES = (
+    endianness.RULES
+    + consensus.RULES
+    + jitpurity.RULES
+    + dtypes.RULES
+    + asyncsafety.RULES
+    + broadexcept.RULES
+)
+
+__all__ = ["ALL_RULES"]

@@ -1,0 +1,66 @@
+"""AS — async-safety for the node's event loop (``node/``, ``ws/``).
+
+One blocking call inside an ``async def`` stalls every connection the
+node is serving: gossip stops fanning out, sync pages stop arriving, and
+the WebSocket hub misses its heartbeats — with no error anywhere, just
+latency.  The aiohttp shell must stay non-blocking end to end; anything
+slow belongs in ``run_in_executor`` (the pattern the verify path already
+uses for device dispatches).
+
+AS001 flags calls to known-blocking APIs lexically inside ``async def``
+(including nested sync helpers defined there, which almost always run on
+the loop thread too): ``time.sleep``, the ``requests`` package, urllib
+openers, ``socket`` connect/DNS, ``subprocess`` (use
+``asyncio.create_subprocess_*``), and ``os.system``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Tuple
+
+from ..engine import SEVERITY_ERROR, FileContext, dotted_name
+
+_SCOPE = {"node", "ws"}
+
+_BLOCKING = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "urllib.request.urlopen": "use the shared aiohttp session",
+    "socket.create_connection": "use asyncio streams / aiohttp",
+    "socket.getaddrinfo": "use loop.getaddrinfo",
+    "subprocess.run": "use asyncio.create_subprocess_exec",
+    "subprocess.call": "use asyncio.create_subprocess_exec",
+    "subprocess.check_call": "use asyncio.create_subprocess_exec",
+    "subprocess.check_output": "use asyncio.create_subprocess_exec",
+    "subprocess.Popen": "use asyncio.create_subprocess_exec",
+    "os.system": "use asyncio.create_subprocess_shell",
+}
+_BLOCKING_PREFIXES = ("requests.",)
+
+
+class BlockingInAsyncRule:
+    rule_id = "AS001"
+    severity = SEVERITY_ERROR
+    description = "blocking call inside async def (node/ws event loop)"
+
+    def scope(self, parts: Tuple[str, ...]) -> bool:
+        return bool(_SCOPE.intersection(parts[:-1]))
+
+    def check(self, ctx: FileContext):
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, ast.AsyncFunctionDef):
+                continue
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                hint = _BLOCKING.get(name)
+                if hint is None and name.startswith(_BLOCKING_PREFIXES):
+                    hint = "use the shared aiohttp session"
+                if hint:
+                    yield (node.lineno, node.col_offset,
+                           f"blocking {name}() inside async def stalls the "
+                           f"whole event loop — {hint} (or run_in_executor)")
+
+
+RULES = [BlockingInAsyncRule()]

@@ -1,0 +1,79 @@
+"""BE — broad-except hygiene (whole package).
+
+``except Exception`` that neither re-raises nor records anything turns
+real failures — a corrupt DB row, a poisoned device, a peer speaking
+garbage — into silence.  The node keeps "working" while its mempool
+drains or its sync quietly stops advancing.  Broad catches are often
+*correct* at daemon boundaries (a background loop must not die), but they
+must leave a trace.
+
+BE001 flags an ``except Exception`` / ``except BaseException`` / bare
+``except:`` handler whose body contains neither:
+
+* a ``raise`` (re-raise or translate), nor
+* a logging-ish call — any ``.debug/.info/.warning/.error/.exception/
+  .critical/.log`` method call, or ``print`` (the CLI's reporting
+  channel), nor
+* an assignment that *captures* the caught exception object for the
+  caller (``box["err"] = e`` — the thread-boxing pattern).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Tuple
+
+from ..engine import SEVERITY_ERROR, FileContext
+
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log"}
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except:
+    if isinstance(t, ast.Name) and t.id in _BROAD:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD for e in t.elts)
+    return False
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    caught = handler.name  # 'e' in `except Exception as e`
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in _LOG_METHODS:
+                return True
+            if isinstance(func, ast.Name) and func.id == "print":
+                return True
+        if caught and isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Name) and node.value.id == caught:
+            return True  # exception object handed to someone else
+    return False
+
+
+class BroadExceptRule:
+    rule_id = "BE001"
+    severity = SEVERITY_ERROR
+    description = "except Exception without re-raise, log call, or capture"
+
+    def scope(self, parts: Tuple[str, ...]) -> bool:
+        return True
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and _is_broad(node) \
+                    and not _handles(node):
+                yield (node.lineno, node.col_offset,
+                       "broad except swallows failures silently — narrow "
+                       "the exception type, re-raise, or add a "
+                       "log.exception(...)/log.debug(...) call")
+
+
+RULES = [BroadExceptRule()]

@@ -1,0 +1,126 @@
+"""CP — consensus-purity.
+
+The consensus layers (``core/``, ``crypto/``, ``verify/``) must be
+bit-exact with the reference chain: integer / Decimal arithmetic only,
+one injectable clock, and no iteration order that can differ between two
+processes validating the same block.
+
+* CP001 — float literal.  IEEE doubles round: ``Decimal(0.5)`` happens to
+  be exact but ``Decimal(0.1)`` is not, and ``x / 10.0`` can disagree
+  with the reference's Decimal math by one ulp — enough to fork.
+* CP002 — direct wall-clock read (``time.time``, ``datetime.now``, ...).
+  Every consensus-path timestamp must come from ``core/clock.timestamp``
+  so tests (and reorg tooling) can move the whole node through time
+  together.  ``time.monotonic``/``perf_counter`` are NOT flagged: they
+  are not wall-clock and are legitimate for caches and profiling.
+* CP003 — iteration over a set.  Set order depends on string hash
+  randomization (PYTHONHASHSEED), so two nodes iterating the same set
+  can serialize/apply in different orders.  Dicts are not flagged:
+  Python dicts iterate in insertion order, which is deterministic.
+* CP004 — ``float(...)`` conversion.  Same ulp hazard as CP001 but at
+  runtime on chain data (the classic is ``int(float(difficulty) * 10)``).
+
+``core/clock.py`` itself is exempt — it is the one designated wrapper
+around ``time.time``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Tuple
+
+from ..engine import SEVERITY_ERROR, FileContext, dotted_name
+
+_SCOPE = {"core", "crypto", "verify"}
+
+_WALL_CLOCK = {
+    "time.time", "time.time_ns",
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.date.today", "date.today",
+}
+
+
+class _ConsensusRule:
+    severity = SEVERITY_ERROR
+
+    def scope(self, parts: Tuple[str, ...]) -> bool:
+        if parts[-1:] == ("clock.py",) and "core" in parts:
+            return False
+        return bool(_SCOPE.intersection(parts[:-1]))
+
+
+class FloatLiteralRule(_ConsensusRule):
+    rule_id = "CP001"
+    description = "float literal in consensus scope"
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Constant) and type(node.value) is float:
+                yield (node.lineno, node.col_offset,
+                       f"float literal {node.value!r} in consensus scope — "
+                       "use int smallest-units or Decimal('...') (or "
+                       "justify+suppress for non-consensus operational "
+                       "values such as timeouts)")
+
+
+class WallClockRule(_ConsensusRule):
+    rule_id = "CP002"
+    description = "direct wall-clock read in consensus scope"
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name in _WALL_CLOCK:
+                    yield (node.lineno, node.col_offset,
+                           f"{name}() in consensus scope — route through "
+                           "core.clock.timestamp() so the whole node moves "
+                           "through time together")
+
+
+class SetIterationRule(_ConsensusRule):
+    rule_id = "CP003"
+    description = "iteration over a set in consensus scope"
+
+    @staticmethod
+    def _is_set_expr(node: ast.AST) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in ("set", "frozenset"):
+            return True
+        return False
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            iters = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                iters.extend(g.iter for g in node.generators)
+            for it in iters:
+                if self._is_set_expr(it):
+                    yield (it.lineno, it.col_offset,
+                           "iterating a set in consensus scope — order "
+                           "depends on hash randomization; sort first "
+                           "(sorted(...)) or use a list/dict")
+
+
+class FloatConversionRule(_ConsensusRule):
+    rule_id = "CP004"
+    description = "float() conversion in consensus scope"
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                    and node.func.id == "float":
+                yield (node.lineno, node.col_offset,
+                       "float() on consensus data loses exactness — keep "
+                       "Decimal/int end to end (classic fork: "
+                       "int(float(difficulty) * 10))")
+
+
+RULES = [FloatLiteralRule(), WallClockRule(), SetIterationRule(),
+         FloatConversionRule()]

@@ -1,0 +1,150 @@
+"""DT — dtype-hygiene for device limb arithmetic (``crypto/``, ``mine/``).
+
+The 256-bit field arithmetic (``crypto/fp.py``) lives entirely in 13-bit
+limbs inside **int32** lanes — the whole design is a proof that no
+intermediate exceeds 2^31 (see fp.py's sweep-count proofs).  The two ways
+that proof silently dies:
+
+* a 64-bit dtype sneaks in: without ``jax_enable_x64`` JAX silently
+  *downcasts* int64 to int32 (values truncate, no error), and with it the
+  TPU VPU has no native 64-bit integer path (everything slows down);
+* a binop mixes explicit dtypes or wraps an out-of-range Python int,
+  promoting lanes or wrapping at construction time.
+
+* DT001 — any reference to ``int64`` / ``uint64`` / ``float64`` via
+  np/jnp (call, ``dtype=`` kw, or ``astype`` argument).  Host-side exact
+  conversions are legitimate — justify + suppress those.
+* DT002 — binop whose two operands are explicit dtype constructors of
+  DIFFERENT dtypes (``jnp.uint32(a) + jnp.int32(b)``): promotion makes
+  the result dtype depend on jax's promotion lattice, not the author.
+* DT003 — explicit 32-bit dtype constructor wrapping an integer literal
+  that does not fit (``jnp.uint32(2**40)``, ``jnp.int32(2**31)``,
+  ``jnp.uint32(-1)``): wraps silently at trace time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Tuple
+
+from ..engine import SEVERITY_ERROR, FileContext, dotted_name
+
+_SCOPE = {"crypto", "mine"}
+_WIDE = {"int64", "uint64", "float64"}
+_NARROW_RANGES = {
+    "int32": (-(2 ** 31), 2 ** 31 - 1),
+    "uint32": (0, 2 ** 32 - 1),
+    "int16": (-(2 ** 15), 2 ** 15 - 1),
+    "uint16": (0, 2 ** 16 - 1),
+    "int8": (-(2 ** 7), 2 ** 7 - 1),
+    "uint8": (0, 2 ** 8 - 1),
+}
+_NS = {"np", "jnp", "numpy"}
+
+
+def _dtype_of(node: ast.AST) -> Optional[str]:
+    """'uint32' for ``jnp.uint32`` / ``np.uint32`` attribute chains."""
+    name = dotted_name(node)
+    if "." in name:
+        ns, attr = name.rsplit(".", 1)
+        if ns in _NS:
+            return attr
+    return None
+
+
+class _DtypeRule:
+    severity = SEVERITY_ERROR
+
+    def scope(self, parts: Tuple[str, ...]) -> bool:
+        return bool(_SCOPE.intersection(parts[:-1]))
+
+
+class WideDtypeRule(_DtypeRule):
+    rule_id = "DT001"
+    description = "64-bit dtype in device limb-arithmetic scope"
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute):
+                dtype = _dtype_of(node)
+                if dtype in _WIDE:
+                    yield (node.lineno, node.col_offset,
+                           f"{dotted_name(node)} in device-arithmetic scope"
+                           " — JAX silently downcasts to 32-bit without "
+                           "jax_enable_x64 and the TPU has no native "
+                           "64-bit integer lanes; keep limb math in int32 "
+                           "(justify+suppress for host-only conversions)")
+
+
+class MixedDtypeBinopRule(_DtypeRule):
+    rule_id = "DT002"
+    description = "binop mixing two explicit, different dtype constructors"
+
+    @staticmethod
+    def _ctor_dtype(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Call):
+            return _dtype_of(node.func)
+        return None
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.BinOp):
+                lt = self._ctor_dtype(node.left)
+                rt = self._ctor_dtype(node.right)
+                if lt and rt and lt != rt:
+                    yield (node.lineno, node.col_offset,
+                           f"binop mixes explicit dtypes {lt} and {rt} — "
+                           "the result dtype follows jax's promotion "
+                           "lattice, not the wider operand; cast one side "
+                           "explicitly")
+
+
+class OverflowLiteralRule(_DtypeRule):
+    rule_id = "DT003"
+    description = "integer literal out of range for its explicit narrow dtype"
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and len(node.args) == 1):
+                continue
+            dtype = _dtype_of(node.func)
+            if dtype not in _NARROW_RANGES:
+                continue
+            value = _const_int(node.args[0])
+            if value is None:
+                continue
+            lo, hi = _NARROW_RANGES[dtype]
+            if not (lo <= value <= hi):
+                yield (node.lineno, node.col_offset,
+                       f"{value} does not fit in {dtype} "
+                       f"[{lo}, {hi}] — wraps silently at trace time")
+
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    """Evaluate small constant int expressions (literals, 2**40, -1, 1<<35)."""
+    if isinstance(node, ast.Constant) and type(node.value) is int:
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _const_int(node.operand)
+        return None if inner is None else -inner
+    if isinstance(node, ast.BinOp):
+        left, right = _const_int(node.left), _const_int(node.right)
+        if left is None or right is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Pow):
+                return left ** right if abs(right) < 512 else None
+            if isinstance(node.op, ast.LShift):
+                return left << right if right < 512 else None
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+        except (OverflowError, ValueError):
+            return None
+    return None
+
+
+RULES = [WideDtypeRule(), MixedDtypeBinopRule(), OverflowLiteralRule()]

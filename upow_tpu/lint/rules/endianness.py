@@ -1,0 +1,101 @@
+"""CE — consensus-endianness.
+
+The uPow wire format is little-endian end to end (``core/constants.py:
+ENDIAN = "little"``, mirroring the reference's ``constants.py:3``).  A
+big-endian ``to_bytes``/``from_bytes`` in a serialization module is a
+consensus break that no unit test exercising only our own encoder+decoder
+can catch (both sides agree with each other and disagree with the chain).
+A *bare* call is just as dangerous: Python 3.11 made ``byteorder``
+default to ``"big"``, so code that "works" on 3.10 by raising starts
+silently producing big-endian bytes on 3.11+.
+
+Allowlist: algorithms whose own specification fixes big-endian byte order
+are exempt as whole modules —
+
+* ``crypto/sha256.py`` — SHA-256's message schedule, length field and
+  digest words are big-endian by FIPS 180-4 (e.g. the padding length at
+  ``sha256.py:92``).
+* ``crypto/p256.py``   — ECDSA's bits2int / digest-to-scalar conversion
+  is big-endian per SEC 1 / RFC 6979 (e.g. ``p256.py:1344``).
+* ``core/curve.py``    — the deterministic-nonce RFC 6979 helpers
+  (bits2int/int2octets) share that convention.
+
+Anything else big-endian in consensus scope must carry an inline
+``# upowlint: disable=CE001`` with a justification (e.g. base58's bigint
+convention in ``core/codecs.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Tuple
+
+from ..engine import SEVERITY_ERROR, FileContext
+
+_SCOPE = {"core", "crypto", "verify"}
+ALLOWLIST = ("crypto/sha256.py", "crypto/p256.py", "core/curve.py")
+
+
+def _in_allowlist(parts: Tuple[str, ...]) -> bool:
+    joined = "/".join(parts)
+    return any(joined.endswith(entry) for entry in ALLOWLIST)
+
+
+class _EndiannessRule:
+    severity = SEVERITY_ERROR
+
+    def scope(self, parts: Tuple[str, ...]) -> bool:
+        return bool(_SCOPE.intersection(parts[:-1])) and not _in_allowlist(parts)
+
+    @staticmethod
+    def _byteorder_arg(call: ast.Call):
+        """The byteorder expression of a to_bytes/from_bytes call, or None.
+
+        Both signatures put byteorder second: ``int.to_bytes(length,
+        byteorder)`` / ``int.from_bytes(bytes, byteorder)``.
+        """
+        if len(call.args) >= 2:
+            return call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "byteorder":
+                return kw.value
+        return None
+
+    def _calls(self, ctx: FileContext) -> Iterable[ast.Call]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("to_bytes", "from_bytes"):
+                yield node
+
+
+class BigEndianRule(_EndiannessRule):
+    rule_id = "CE001"
+    description = ("explicit 'big' byteorder in consensus serialization "
+                   "(uPow wire format is little-endian)")
+
+    def check(self, ctx: FileContext):
+        for call in self._calls(ctx):
+            order = self._byteorder_arg(call)
+            if isinstance(order, ast.Constant) and order.value == "big":
+                yield (call.lineno, call.col_offset,
+                       "big-endian to_bytes/from_bytes in consensus scope; "
+                       "the uPow wire format is little-endian — use "
+                       "core.constants.ENDIAN (or justify+suppress for "
+                       "algorithm-mandated byte order)")
+
+
+class BareByteorderRule(_EndiannessRule):
+    rule_id = "CE002"
+    description = ("to_bytes/from_bytes without an explicit byteorder "
+                   "(defaults to big-endian on Python 3.11+)")
+
+    def check(self, ctx: FileContext):
+        for call in self._calls(ctx):
+            if self._byteorder_arg(call) is None:
+                yield (call.lineno, call.col_offset,
+                       "bare to_bytes/from_bytes: byteorder defaults to "
+                       "'big' on Python 3.11+ (and raises on 3.10) — pass "
+                       "core.constants.ENDIAN explicitly")
+
+
+RULES = [BigEndianRule(), BareByteorderRule()]

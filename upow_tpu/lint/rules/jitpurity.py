@@ -1,0 +1,266 @@
+"""JP — jit-purity.
+
+A function under ``@jax.jit`` traces once per static signature; Python
+control flow and host syncs inside it either crash at trace time
+(``TracerBoolConversionError``) or — worse — silently bake a data
+-dependent decision into the compiled program or force a device->host
+round trip per call, which is exactly how the ≥1 GH/s sha256 and ≥100k
+sig-verify/s targets regress to eager-speed without any test failing.
+
+The checker runs a small taint analysis per decorated function:
+
+* **Traced names** start as the function's parameters minus
+  ``static_argnames`` / ``static_argnums`` (parsed from the decorator).
+* Assignments propagate taint; so do for-loop targets over tainted
+  iterables.
+* **Taint breakers**: ``x.shape`` / ``x.ndim`` / ``x.dtype`` / ``x.size``
+  and ``len(x)`` are static under tracing (Python ints), so expressions
+  built from them — e.g. ``assert n % 128 == 0`` with
+  ``n = q.shape[1]`` — are NOT flagged.
+* Nested ``def``/``lambda`` bodies are analyzed with their own parameters
+  treated as traced (the ``shard_map``/``pallas_call`` body pattern).
+
+Rules:
+
+* JP001 — ``if`` / ``while`` / ``assert`` / conditional expression whose
+  test involves a traced value.
+* JP002 — host sync on a traced value: ``float()`` / ``int()`` /
+  ``bool()``, ``.item()`` / ``.tolist()``, ``np.asarray`` / ``np.array``.
+* JP003 — ``jnp.array(...)`` construction inside a jitted function
+  (warning): prefer ``jnp.asarray`` (no-copy for arrays) or hoisting the
+  constant out of the traced body.
+
+Helpers *called from* a jitted function are not followed — this is a
+commit-time tripwire for the decorated surfaces, not an interprocedural
+analyzer; ``jax.checking_leaks`` remains the runtime backstop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from ..engine import SEVERITY_ERROR, SEVERITY_WARNING, FileContext, dotted_name
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+_HOST_METHODS = {"item", "tolist"}
+_NP_SYNCS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+def _jit_static_info(func: ast.AST) -> Optional[Tuple[Set[str], Set[int]]]:
+    """(static_argnames, static_argnums) if ``func`` is jit-decorated."""
+    for dec in getattr(func, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target)
+        if name in ("jax.jit", "jit"):
+            names, nums = set(), set()
+            if isinstance(dec, ast.Call):
+                names, nums = _static_kwargs(dec)
+            return names, nums
+        if name in ("functools.partial", "partial") and isinstance(dec, ast.Call) \
+                and dec.args and dotted_name(dec.args[0]) in ("jax.jit", "jit"):
+            return _static_kwargs(dec)
+    return None
+
+
+def _static_kwargs(call: ast.Call) -> Tuple[Set[str], Set[int]]:
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.add(n.value)
+    return names, nums
+
+
+def _param_names(func) -> List[str]:
+    a = func.args
+    return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+def _expr_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+    """Does ``node`` reference a traced name outside a static context?"""
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return False  # x.shape etc. are Python values under tracing
+    if isinstance(node, ast.Call):
+        callee = node.func
+        if isinstance(callee, ast.Name) and callee.id == "len":
+            return False  # len(traced) is static
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    return any(_expr_tainted(child, tainted)
+               for child in ast.iter_child_nodes(node))
+
+
+def _assign_targets(target: ast.AST) -> Iterable[str]:
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name):
+            yield node.id
+
+
+class _JitVisitor:
+    """Single linear pass over one jitted function body."""
+
+    def __init__(self, tainted: Set[str]):
+        self.tainted = set(tainted)
+        self.findings: List[Tuple[int, int, str, str]] = []  # +rule key
+
+    def visit_body(self, body: Iterable[ast.stmt]) -> None:
+        for stmt in body:
+            self._visit_stmt(stmt)
+
+    # -- statements -------------------------------------------------------
+    def _visit_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = stmt.value
+            if value is not None:
+                self._scan_expr(value)
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                if _expr_tainted(value, self.tainted):
+                    for name in targets:
+                        self.tainted.update(_assign_targets(name))
+        elif isinstance(stmt, (ast.If, ast.While)):
+            if _expr_tainted(stmt.test, self.tainted):
+                self.findings.append((
+                    stmt.test.lineno, stmt.test.col_offset, "JP001",
+                    f"Python `{'if' if isinstance(stmt, ast.If) else 'while'}`"
+                    " on a traced value inside @jax.jit — use jnp.where/"
+                    "lax.cond/lax.while_loop, or mark the argument static"))
+            self._scan_expr(stmt.test)
+            self.visit_body(stmt.body)
+            self.visit_body(stmt.orelse)
+        elif isinstance(stmt, ast.Assert):
+            if _expr_tainted(stmt.test, self.tainted):
+                self.findings.append((
+                    stmt.lineno, stmt.col_offset, "JP001",
+                    "assert on a traced value inside @jax.jit — asserts "
+                    "must only touch static args or .shape-derived values "
+                    "(use checkify for traced invariants)"))
+            self._scan_expr(stmt.test)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_expr(stmt.iter)
+            if _expr_tainted(stmt.iter, self.tainted):
+                self.tainted.update(_assign_targets(stmt.target))
+                self.findings.append((
+                    stmt.iter.lineno, stmt.iter.col_offset, "JP001",
+                    "Python loop over a traced value inside @jax.jit — "
+                    "iteration count must be static (use lax.fori_loop/"
+                    "scan for traced trip counts)"))
+            self.visit_body(stmt.body)
+            self.visit_body(stmt.orelse)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = _JitVisitor(self.tainted | set(_param_names(stmt)))
+            inner.visit_body(stmt.body)
+            self.findings.extend(inner.findings)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._scan_expr(item.context_expr)
+            self.visit_body(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self.visit_body(stmt.body)
+            for h in stmt.handlers:
+                self.visit_body(h.body)
+            self.visit_body(stmt.orelse)
+            self.visit_body(stmt.finalbody)
+        elif isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value)
+
+    # -- expressions ------------------------------------------------------
+    def _scan_expr(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.IfExp) and \
+                    _expr_tainted(node.test, self.tainted):
+                self.findings.append((
+                    node.lineno, node.col_offset, "JP001",
+                    "conditional expression on a traced value inside "
+                    "@jax.jit — use jnp.where/lax.select"))
+            elif isinstance(node, ast.Lambda):
+                inner = _JitVisitor(self.tainted | set(_param_names(node)))
+                inner._scan_expr(node.body)
+                self.findings.extend(inner.findings)
+            elif isinstance(node, ast.Call):
+                self._scan_call(node)
+
+    def _scan_call(self, node: ast.Call) -> None:
+        func = node.func
+        args_tainted = any(_expr_tainted(a, self.tainted) for a in node.args)
+        if isinstance(func, ast.Name) and func.id in _HOST_CASTS and args_tainted:
+            self.findings.append((
+                node.lineno, node.col_offset, "JP002",
+                f"{func.id}() on a traced value inside @jax.jit forces a "
+                "host sync (TracerBoolConversionError or a blocking "
+                "transfer) — keep it on device or mark the arg static"))
+        elif isinstance(func, ast.Attribute) and func.attr in _HOST_METHODS \
+                and _expr_tainted(func.value, self.tainted):
+            self.findings.append((
+                node.lineno, node.col_offset, "JP002",
+                f".{func.attr}() on a traced value inside @jax.jit is a "
+                "blocking device->host transfer"))
+        else:
+            name = dotted_name(func)
+            if name in _NP_SYNCS and args_tainted:
+                self.findings.append((
+                    node.lineno, node.col_offset, "JP002",
+                    f"{name}() on a traced value inside @jax.jit "
+                    "materializes on host — use jnp equivalents"))
+            elif name == "jnp.array":
+                self.findings.append((
+                    node.lineno, node.col_offset, "JP003",
+                    "jnp.array(...) inside @jax.jit re-stages its argument "
+                    "every trace — prefer jnp.asarray (no-copy) or hoist "
+                    "the constant out of the jitted body"))
+
+
+def _jit_findings(ctx: FileContext):
+    for func in ast.walk(ctx.tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        info = _jit_static_info(func)
+        if info is None:
+            continue
+        static_names, static_nums = info
+        params = _param_names(func)
+        tainted = {p for i, p in enumerate(params)
+                   if p not in static_names and i not in static_nums}
+        visitor = _JitVisitor(tainted)
+        visitor.visit_body(func.body)
+        yield from visitor.findings
+
+
+class _JitRuleBase:
+    def scope(self, parts: Tuple[str, ...]) -> bool:
+        return True  # jit purity is an invariant everywhere
+
+    def check(self, ctx: FileContext):
+        for line, col, key, message in _jit_findings(ctx):
+            if key == self.rule_id:
+                yield line, col, message
+
+
+class TracedBranchRule(_JitRuleBase):
+    rule_id = "JP001"
+    severity = SEVERITY_ERROR
+    description = "Python control flow on a traced value inside @jax.jit"
+
+
+class HostSyncRule(_JitRuleBase):
+    rule_id = "JP002"
+    severity = SEVERITY_ERROR
+    description = "host sync (float()/int()/.item()/np.asarray) on a traced value inside @jax.jit"
+
+
+class JnpArrayRule(_JitRuleBase):
+    rule_id = "JP003"
+    severity = SEVERITY_WARNING
+    description = "jnp.array(...) construction inside @jax.jit (prefer jnp.asarray / hoisting)"
+
+
+RULES = [TracedBranchRule(), HostSyncRule(), JnpArrayRule()]

@@ -488,6 +488,7 @@ class Node:
         try:
             tx = await self._parse_tx(tx_hex)
         except Exception as e:
+            log.debug("push_tx: rejecting unparseable tx: %s", e)
             return web.json_response(
                 {"ok": False, "error": f"Invalid transaction: {e}"})
         result = await self._verify_and_push_tx(
@@ -537,6 +538,8 @@ class Node:
         try:
             previous_hash = split_block_content(block_content)[0]
         except Exception as e:
+            log.debug("push_block: malformed block content from %s: %s",
+                      sender, e)
             return web.json_response(
                 {"ok": False, "error": f"malformed block content: {e}"})
         next_block_id = await self.state.get_next_block_id()
@@ -800,7 +803,8 @@ class Node:
         iface = NodeInterface(url, self.config.node, session=self._session())
         try:
             await iface.get("")
-        except Exception:
+        except Exception as e:
+            log.debug("add_node: probe of %s failed: %s", url, e)
             return web.json_response(
                 {"ok": False, "error": "Could not add node"})
         self._spawn(self.propagate("add_node", {"url": url}, ignore_url=url))
@@ -922,6 +926,7 @@ class Node:
             tx = await builder.create_transaction(
                 private_key, to_address, Decimal(str(amount)))
         except Exception as e:
+            log.debug("send_to_address: tx build failed: %s", e)
             return web.json_response({"ok": False, "error": str(e)})
         result = await self._verify_and_push_tx(
             tx, request.headers.get("Sender-Node"))
@@ -1162,6 +1167,7 @@ class Node:
                 # keep the valid prefix: the accept loop below still
                 # commits every block parsed so far (the interleaved
                 # reference loop made the same forward progress)
+                log.debug("sync: stopping page at unparseable block: %s", e)
                 parse_error = f"block parse failed: {e}"
                 break
             coinbase = None
@@ -1266,7 +1272,9 @@ class Node:
             for tx in txs:
                 try:
                     c = await verifier.collect_sig_checks(tx)
-                except Exception:
+                except Exception as e:
+                    # prefill is best-effort; the accept loop re-verifies
+                    log.debug("sig-check prefill skipped a tx: %s", e)
                     c = None
                 if c:
                     checks.extend(c)

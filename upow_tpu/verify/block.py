@@ -77,7 +77,8 @@ class BlockManager:
 
     def __init__(self, state: ChainState, sig_backend: str = "auto",
                  verify_pad_block: int = 128,
-                 verify_device_timeout: float = 240.0,
+                 # operational timeout, not consensus data
+                 verify_device_timeout: float = 240.0,  # upowlint: disable=CP001
                  verify_mesh_devices: int = 1):
         self.state = state
         self.sig_backend = sig_backend
@@ -88,7 +89,7 @@ class BlockManager:
         self.verify_mesh_devices = verify_mesh_devices
         self._difficulty_cache: Optional[Tuple[Decimal, dict]] = None
         self._inode_cache: Optional[List[dict]] = None
-        self._inode_cache_time = 0.0
+        self._inode_cache_time = 0.0  # monotonic epoch, not consensus  # upowlint: disable=CP001
         self.is_syncing = False
         # transient page-level signature verdicts (chain-sync prefill):
         # set by the node's create_blocks around a page's accept loop
@@ -131,7 +132,7 @@ class BlockManager:
 
     # ------------------------------------------------------ inode cache ---
 
-    async def get_active_inodes_cached(self, max_age: float = 300.0) -> List[dict]:
+    async def get_active_inodes_cached(self, max_age: float = 300.0) -> List[dict]:  # cache TTL, not consensus  # upowlint: disable=CP001
         """5-minute active-inode cache (manager.py:30-32, 870-900)."""
         if self._inode_cache is not None and \
                 time.monotonic() - self._inode_cache_time < max_age:

@@ -92,7 +92,7 @@ def _device_usable() -> bool:
         return False
     from ..benchutil import probed_platform_cached
 
-    platform = probed_platform_cached(timeout=90.0)
+    platform = probed_platform_cached(timeout=90.0)  # probe timeout, not consensus  # upowlint: disable=CP001
     if platform is None:
         _DEVICE_POISONED = True
         import logging
@@ -106,7 +106,7 @@ def _device_usable() -> bool:
 async def run_sig_checks_async(checks: Sequence[tuple],
                                backend: str = "auto",
                                pad_block: int = 128,
-                               device_timeout: float = 240.0,
+                               device_timeout: float = 240.0,  # operational timeout  # upowlint: disable=CP001
                                precomputed=None,
                                mesh_devices: int = 1) -> List[bool]:
     """Executor-wrapped :func:`run_sig_checks`: the device dispatch (and
@@ -197,7 +197,7 @@ def _resolve_backend(backend: str, n_checks: int) -> str:
 
 def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
                    pad_block: int = 128,
-                   device_timeout: float = 240.0,
+                   device_timeout: float = 240.0,  # operational timeout  # upowlint: disable=CP001
                    use_cache: bool = True,
                    precomputed=None,
                    mesh_devices: int = 1) -> List[bool]:
@@ -337,11 +337,16 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
             "(device poisoned for this process)")
         raise TimeoutError("device verify hung")
 
+    import logging
+
+    log = logging.getLogger("upow_tpu.verify")
     try:
         first = device_batch(
             [c[0] for c in checks], [c[2] for c in checks],
             [c[3] for c in checks])
-    except Exception:
+    except Exception as e:
+        log.warning("device verify pass-1 unusable (%s); host fallback for "
+                    "%d checks", e, len(checks))
         return run_sig_checks(checks, backend="host", pad_block=pad_block,
                               device_timeout=device_timeout, use_cache=False)
     out = list(map(bool, first))
@@ -352,9 +357,11 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
                 [checks[i][1] for i in retry],
                 [checks[i][2] for i in retry],
                 [checks[i][3] for i in retry])
-        except Exception:
+        except Exception as e:
             # pass-1 verdicts are already in hand (same math on device);
             # only the hex-digest retries need the host
+            log.debug("device verify pass-2 unusable (%s); host retry for "
+                      "%d checks", e, len(retry))
             second = [_host_verify_digest(checks[i][1], checks[i][2],
                                           checks[i][3]) for i in retry]
         for i, ok in zip(retry, second):
@@ -369,7 +376,9 @@ def _host_verify_digest(digest: bytes, sig, pub) -> bool:
     r, s = sig
     if not (0 < r < CURVE_N and 0 < s < CURVE_N):
         return False
-    z = int.from_bytes(digest, "big")
+    # ECDSA bits2int (SEC 1 / RFC 6979): the digest is a big-endian
+    # integer by the signature algorithm's definition, not wire format.
+    z = int.from_bytes(digest, "big")  # upowlint: disable=CE001
     w = pow(s, -1, CURVE_N)
     p1 = curve.point_mul(z * w % CURVE_N, curve.G)
     p2 = curve.point_mul(r * w % CURVE_N, pub)
@@ -386,7 +395,7 @@ class TxVerifier:
 
     def __init__(self, state: ChainState, is_syncing: bool = False,
                  verify_pad_block: int = 128,
-                 verify_device_timeout: float = 240.0,
+                 verify_device_timeout: float = 240.0,  # operational timeout  # upowlint: disable=CP001
                  tx_overlay: Optional[Dict[str, Tx]] = None,
                  verify_mesh_devices: int = 1):
         self.state = state

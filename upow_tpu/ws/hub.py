@@ -225,8 +225,9 @@ class WsHub:
                     log.info("ws expire %s", conn.id)
                     try:
                         await conn.ws.close()
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        # peer may already be gone; still worth a trace
+                        log.debug("ws close %s failed: %s", conn.id, e)
                     self._drop(conn)
 
     async def _stats_loop(self) -> None:
