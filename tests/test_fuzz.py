@@ -237,12 +237,14 @@ def test_sig_checks_survive_hung_device(monkeypatch):
 
     monkeypatch.setattr(p256, "verify_batch_prehashed",
                         lambda *a, **k: _time.sleep(600))
-    monkeypatch.setattr(txverify, "_DEVICE_POISONED", False)
+    from upow_tpu.resilience.degrade import DegradeManager
+
+    monkeypatch.setattr(txverify, "DEGRADE", DegradeManager())
     t0 = _time.monotonic()
     out = txverify.run_sig_checks(checks, backend="device",
                                   device_timeout=1.5)
     assert _time.monotonic() - t0 < 30
-    assert txverify._DEVICE_POISONED
+    assert txverify.DEGRADE.state == "poisoned"
     # use_cache=False throughout: each assertion below claims a specific
     # BACKEND ROUTING behavior — a verdict-cache hit would satisfy the
     # equality without exercising the routing at all

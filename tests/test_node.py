@@ -598,7 +598,8 @@ def test_sync_retries_past_dead_peers(tmp_path, keys):
             result = await node_b.sync_blockchain()
         finally:
             app_mod.random.sample = orig_sample
-        assert result is True, result
+        assert result["ok"] is True, result
+        assert result["peer"] == cluster.url(0)
         assert (await node_a.state.get_unspent_outputs_hash()
                 == await node_b.state.get_unspent_outputs_hash())
 

@@ -1,0 +1,493 @@
+"""Unit tests for the resilience layer (upow_tpu/resilience/): retry
+policy math and deadline budgets, circuit-breaker state machine, device
+degradation manager, deterministic fault injection — plus the satellite
+coverage for RateLimiter._sweep and the ws hub idle-expiry loop, both
+previously untested failure-path code.
+
+Everything here is deterministic: clocks, sleeps, and rngs are injected;
+no test depends on wall-clock scheduling except the ws expiry test,
+which polls a real event loop with generous margins.
+"""
+
+import asyncio
+import random
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from upow_tpu import trace
+from upow_tpu.resilience import (CircuitBreaker, BreakerRegistry,
+                                 CircuitOpenError, DeadlineExceeded,
+                                 DegradeManager, FaultInjected,
+                                 FaultInjector, RetryPolicy,
+                                 call_with_retry, faultinject)
+from upow_tpu.resilience.faultinject import parse_spec
+
+
+# ------------------------------------------------------------ policy ----
+
+def test_backoff_progression_and_cap():
+    policy = RetryPolicy(base_delay=0.25, multiplier=2.0, max_delay=2.0,
+                         jitter=0.0)
+    assert [policy.delay_for(n) for n in range(1, 6)] == \
+        [0.25, 0.5, 1.0, 2.0, 2.0]
+
+
+def test_backoff_jitter_is_seed_deterministic():
+    policy = RetryPolicy(jitter=0.5)
+    a = [policy.delay_for(n, random.Random(7)) for n in range(1, 5)]
+    b = [policy.delay_for(n, random.Random(7)) for n in range(1, 5)]
+    assert a == b
+    # jitter stays within the +/- band around the unjittered value
+    flat = RetryPolicy(jitter=0.0)
+    for n, delay in enumerate(a, start=1):
+        base = flat.delay_for(n)
+        assert base * 0.5 <= delay <= base * 1.5
+
+
+def _fake_time():
+    t = [0.0]
+
+    async def sleep(d):
+        t[0] += d
+
+    return t, (lambda: t[0]), sleep
+
+
+def test_retry_succeeds_after_transient_failures():
+    calls = {"n": 0}
+    retries = []
+
+    async def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("flap")
+        return "done"
+
+    _, clock, sleep = _fake_time()
+
+    async def main():
+        return await call_with_retry(
+            flaky, RetryPolicy(attempts=3, jitter=0.0),
+            retry_on=(ConnectionError,),
+            on_retry=lambda e, n: retries.append(n),
+            clock=clock, sleep=sleep)
+
+    assert asyncio.run(main()) == "done"
+    assert calls["n"] == 3
+    assert retries == [1, 2]
+
+
+def test_retry_gives_up_after_attempts():
+    async def dead():
+        raise ConnectionError("down")
+
+    _, clock, sleep = _fake_time()
+
+    async def main():
+        await call_with_retry(dead, RetryPolicy(attempts=3, jitter=0.0),
+                              retry_on=(ConnectionError,),
+                              clock=clock, sleep=sleep)
+
+    with pytest.raises(ConnectionError):
+        asyncio.run(main())
+
+
+def test_retry_deadline_budget_exhausts():
+    """Backoff sleeps are clamped to the remaining budget and the next
+    attempt is refused once the deadline is spent."""
+    attempts = {"n": 0}
+
+    async def dead():
+        attempts["n"] += 1
+        raise ConnectionError("down")
+
+    t, clock, sleep = _fake_time()
+
+    async def main():
+        await call_with_retry(
+            dead,
+            RetryPolicy(attempts=10, base_delay=10.0, jitter=0.0,
+                        deadline=1.0),
+            retry_on=(ConnectionError,), clock=clock, sleep=sleep)
+
+    with pytest.raises(DeadlineExceeded):
+        asyncio.run(main())
+    assert attempts["n"] == 1        # one try, then the budget was gone
+    assert t[0] == pytest.approx(1.0)  # slept exactly the clamped budget
+
+
+def test_retry_non_retryable_propagates_immediately():
+    calls = {"n": 0}
+
+    async def broken():
+        calls["n"] += 1
+        raise ValueError("not transport")
+
+    async def main():
+        await call_with_retry(broken, RetryPolicy(attempts=5),
+                              retry_on=(ConnectionError,))
+
+    with pytest.raises(ValueError):
+        asyncio.run(main())
+    assert calls["n"] == 1
+
+
+# ----------------------------------------------------------- breaker ----
+
+def test_breaker_full_cycle_with_fake_clock():
+    t = [0.0]
+    breaker = CircuitBreaker(failure_threshold=3, open_secs=30.0,
+                             half_open_max=1, clock=lambda: t[0])
+    assert breaker.state == "closed" and breaker.available()
+    for _ in range(3):
+        breaker.record_failure()
+    assert breaker.state == "open"
+    assert not breaker.available() and not breaker.usable()
+    t[0] = 29.0
+    assert breaker.state == "open"
+    t[0] = 30.5
+    assert breaker.state == "half_open"
+    assert breaker.usable()
+    assert breaker.available()        # first trial slot
+    assert not breaker.available()    # half_open_max=1: slot consumed
+    assert breaker.usable()           # ...but selection peeks freely
+    breaker.record_success()
+    assert breaker.state == "closed"
+    assert breaker.transitions == ["closed", "open", "half_open", "closed"]
+
+
+def test_breaker_half_open_failure_reopens():
+    t = [0.0]
+    breaker = CircuitBreaker(failure_threshold=1, open_secs=10.0,
+                             clock=lambda: t[0])
+    breaker.record_failure()
+    t[0] = 11.0
+    assert breaker.state == "half_open"
+    breaker.record_failure()
+    assert breaker.state == "open"
+    t[0] = 20.0
+    assert breaker.state == "open"    # re-opened at t=11, waits to 21
+    t[0] = 21.5
+    assert breaker.state == "half_open"
+
+
+def test_breaker_score_ewma_and_registry():
+    reg = BreakerRegistry(failure_threshold=5)
+    assert reg.score("http://x") == 1.0      # unknown peers read healthy
+    assert reg.usable("http://x") and reg.available("http://x")
+    for _ in range(4):
+        reg.record_failure("http://x")
+    assert reg.score("http://x") < 0.5
+    reg.record_success("http://x")
+    assert 0.2 < reg.score("http://x") < 1.0
+    reg.record_failure("http://y")
+    counts = reg.state_counts()
+    assert counts["closed"] == 2 and counts["open"] == 0
+    snap = reg.snapshot()
+    assert set(snap) == {"http://x", "http://y"}
+    assert snap["http://y"]["consecutive_failures"] == 1
+
+
+def test_peerbook_selection_skips_open_and_prefers_healthy(tmp_path):
+    from upow_tpu.config import NodeConfig
+    from upow_tpu.node.peers import PeerBook
+
+    cfg = NodeConfig(seed_url="", peers_file="", propagate_sample=2)
+    book = PeerBook(cfg)
+    urls = [f"http://10.0.0.{i}:3006" for i in range(4)]
+    for u in urls:
+        book.add(u)
+        book.update_last_message(u)
+    # peer 0: circuit open (skipped); peer 1: degraded score (last resort)
+    for _ in range(5):
+        book.breakers.record_failure(urls[0])
+    for _ in range(3):
+        book.breakers.record_failure(urls[1])
+    assert book.breakers.peek(urls[0]).state == "open"
+    for _ in range(50):
+        picks = book.propagate_nodes()
+        assert urls[0] not in picks
+        assert len(picks) == 2
+        # both healthy peers fill the sample before the weak-score tier
+        assert set(picks) == {urls[2], urls[3]}
+    ordered = book.ranked(list(urls))
+    assert ordered[-1] == urls[0]            # open circuit last
+    assert ordered[-2] == urls[1]            # weak score next-to-last
+    assert set(ordered[:2]) == {urls[2], urls[3]}
+
+
+# ----------------------------------------------------------- degrade ----
+
+def test_degrade_cycle_error_cooldown_recovery():
+    t = [0.0]
+    mgr = DegradeManager(failure_limit=2, cooldown=60.0,
+                         clock=lambda: t[0])
+    trace.reset()
+    assert mgr.allow() and mgr.state == "ok"
+    mgr.record_failure(RuntimeError("xla"))
+    assert mgr.state == "ok"                 # below the limit
+    mgr.record_failure(RuntimeError("xla"))
+    assert mgr.state == "degraded"
+    assert not mgr.allow()                   # benched: CPU fallback
+    t[0] = 59.0
+    assert not mgr.allow()
+    t[0] = 61.0
+    assert mgr.allow()                       # cooldown elapsed: re-probe
+    assert mgr.allow()                       # in-flight probe keeps flowing
+    mgr.record_success()
+    assert mgr.state == "ok"
+    counters = trace.counters()
+    assert counters["resilience.device_degraded"] == 1
+    assert counters["resilience.device_reprobe"] == 1
+    assert counters["resilience.device_recovered"] == 1
+    assert counters["resilience.device_fallback"] >= 2
+
+
+def test_degrade_failed_probe_rebenches():
+    t = [0.0]
+    mgr = DegradeManager(failure_limit=1, cooldown=10.0,
+                         clock=lambda: t[0])
+    mgr.record_failure()
+    assert mgr.state == "degraded"
+    t[0] = 11.0
+    assert mgr.allow()
+    mgr.record_failure()                     # probe failed
+    assert mgr.state == "degraded"
+    assert not mgr.allow()                   # new cooldown from t=11
+    t[0] = 20.0
+    assert not mgr.allow()
+    t[0] = 21.5
+    assert mgr.allow()
+
+
+def test_degrade_poison_is_permanent():
+    t = [0.0]
+    mgr = DegradeManager(failure_limit=3, cooldown=1.0, clock=lambda: t[0])
+    mgr.poison("hang")
+    assert mgr.state == "poisoned" and mgr.state_gauge() == 2
+    t[0] = 1e9
+    assert not mgr.allow()                   # no cooldown out of poison
+    mgr.record_success()
+    assert mgr.state == "poisoned"
+
+
+# ------------------------------------------------------- faultinject ----
+
+def test_fault_spec_parsing_and_validation():
+    faults = parse_spec(
+        "rpc:error:p=0.5,key=9001;device.verify:hang:times=1;"
+        "ws.send:latency:delay=0.25")
+    assert [(f.site, f.kind) for f in faults] == [
+        ("rpc", "error"), ("device.verify", "hang"), ("ws.send", "latency")]
+    assert faults[0].p == 0.5 and faults[0].key == "9001"
+    assert faults[1].delay == 3600.0         # hang default
+    assert faults[2].delay == 0.25
+    with pytest.raises(ValueError):
+        parse_spec("rpc")                    # missing kind
+    with pytest.raises(ValueError):
+        parse_spec("rpc:explode")            # unknown kind
+    with pytest.raises(ValueError):
+        parse_spec("rpc:error:zap=1")        # unknown option
+
+
+def test_fault_matching_prefix_key_and_times():
+    fault = parse_spec("rpc:error:times=2,key=127.0.0.1:9001")[0]
+    assert fault.matches("rpc.get_blocks", "http://127.0.0.1:9001")
+    assert not fault.matches("rpcx", "http://127.0.0.1:9001")
+    assert not fault.matches("rpc.get_blocks", "http://127.0.0.1:9002")
+    inj = FaultInjector("rpc:error:times=2", seed=1)
+    hits = 0
+    for _ in range(5):
+        try:
+            inj.fire_sync("rpc.get", "any")
+        except FaultInjected:
+            hits += 1
+    assert hits == 2                         # times cap honored
+    assert inj.snapshot()[0]["fired"] == 2
+
+
+def test_fault_probability_is_seed_deterministic():
+    def schedule(seed):
+        inj = FaultInjector("rpc:error:p=0.5", seed=seed)
+        out = []
+        for _ in range(32):
+            try:
+                inj.fire_sync("rpc", "k")
+                out.append(0)
+            except FaultInjected:
+                out.append(1)
+        return out
+
+    assert schedule(42) == schedule(42)
+    assert schedule(42) != schedule(43)
+    assert 0 < sum(schedule(42)) < 32        # actually probabilistic
+
+
+def test_fault_latency_and_async_fire():
+    async def main():
+        inj = FaultInjector("ws.send:latency:delay=0.01;rpc:error")
+        t0 = asyncio.get_event_loop().time()
+        await inj.fire("ws.send", "conn")    # sleeps, does not raise
+        assert asyncio.get_event_loop().time() - t0 >= 0.009
+        with pytest.raises(FaultInjected):
+            await inj.fire("rpc.push_block", "peer")
+        await inj.fire("unrelated.site", "x")  # no matching rule: no-op
+
+    asyncio.run(main())
+
+
+def test_injector_global_install_uninstall():
+    assert faultinject.get_injector() is None
+    try:
+        inj = faultinject.install("rpc:error", seed=3)
+        assert faultinject.get_injector() is inj
+        assert faultinject.install("") is None     # empty spec disables
+        assert faultinject.get_injector() is None
+    finally:
+        faultinject.uninstall()
+
+
+def test_node_interface_retries_then_breaks(tmp_path):
+    """NodeInterface under a ResilienceContext: injected transport faults
+    are retried; persistent failure trips the breaker; an open breaker
+    short-circuits without touching the network."""
+    from upow_tpu.config import NodeConfig, ResilienceConfig
+    from upow_tpu.node.peers import NodeInterface
+    from upow_tpu.resilience import ResilienceContext
+
+    rcfg = ResilienceConfig(rpc_attempts=1, rpc_backoff_base=0.0,
+                            rpc_jitter=0.0, rpc_deadline=5.0,
+                            breaker_failure_threshold=2,
+                            breaker_open_secs=60.0)
+    ctx = ResilienceContext.from_config(rcfg)
+    iface = NodeInterface("http://127.0.0.1:1", NodeConfig(seed_url=""),
+                          resilience=ctx)
+
+    async def main():
+        try:
+            faultinject.install("rpc:error", seed=0)
+            trace.reset()
+            for _ in range(2):
+                with pytest.raises(ConnectionError):
+                    await iface.get("")
+            assert ctx.breakers.peek(iface.base_url).state == "open"
+            with pytest.raises(CircuitOpenError):
+                await iface.get("")
+            assert trace.counters()["resilience.breaker_rejected"] == 1
+            # injector never saw a third call: the breaker refused first
+            assert faultinject.get_injector().snapshot()[0]["fired"] == 2
+        finally:
+            faultinject.uninstall()
+            await iface.close()
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------- satellite coverage ---
+
+def test_ratelimiter_enforces_and_sweeps(monkeypatch):
+    from upow_tpu.node import ratelimit
+    from upow_tpu.node.ratelimit import RateLimiter
+
+    limiter = RateLimiter(limits={"/x": "2/second"})
+    assert limiter.allow("1.2.3.4", "/x")
+    assert limiter.allow("1.2.3.4", "/x")
+    assert not limiter.allow("1.2.3.4", "/x")     # third within the window
+    assert limiter.allow("5.6.7.8", "/x")         # other IPs unaffected
+    assert limiter.allow("1.2.3.4", "/unlimited")  # unknown endpoint: free
+
+    # _sweep drops fully-expired windows, keeps live ones
+    now = ratelimit.time.monotonic()
+    assert ("1.2.3.4", "/x") in limiter._hits
+    limiter._sweep(now + 0.5)
+    assert ("1.2.3.4", "/x") in limiter._hits     # still within 1 s
+    limiter._sweep(now + 5.0)
+    assert limiter._hits == {}                    # scan residue collected
+
+
+def test_ratelimiter_auto_sweep_trigger():
+    """The lazy sweep fires every 4096th allow() call, so a scan from
+    many source IPs cannot grow the dict unboundedly."""
+    from upow_tpu.node.ratelimit import RateLimiter
+
+    limiter = RateLimiter(limits={"/x": "5/second"})
+    swept = []
+    limiter._sweep = lambda now: swept.append(now)
+    for i in range(4096 * 2):
+        limiter.allow(f"ip{i}", "/x")
+    assert len(swept) == 2
+
+
+def test_ws_hub_idle_expiry(tmp_path):
+    """_cleanup_loop (previously untested) must close and unregister a
+    connection idle past connection_expiry, on the configurable sweep
+    interval — and leave a fresh/active connection alone."""
+    from upow_tpu.config import WsConfig
+    from upow_tpu.ws.hub import WsHub
+
+    async def main():
+        cfg = WsConfig(heartbeat_interval=1000.0, connection_expiry=0.3,
+                       cleanup_interval=0.05)
+        hub = WsHub(cfg)
+        app = web.Application()
+        app.router.add_get("/ws", hub.handle)
+        server = TestServer(app)
+        await server.start_server()
+        client = TestClient(server)
+        try:
+            ws = await client.ws_connect("/ws")
+            hello = await ws.receive_json()
+            assert hello["type"] == "connection_established"
+            assert hub.get_stats()["total_connections"] == 1
+            # keep it active past one expiry window: pings refresh
+            # last_activity, so the sweep must NOT reap it
+            for _ in range(4):
+                await ws.send_json({"type": "ping"})
+                assert (await ws.receive_json())["type"] == "pong"
+                await asyncio.sleep(0.1)
+            assert hub.get_stats()["total_connections"] == 1
+            # now go idle: the cleanup loop closes + drops it
+            for _ in range(100):
+                if hub.get_stats()["total_connections"] == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert hub.get_stats()["total_connections"] == 0
+            msg = await ws.receive()         # server-initiated close frame
+            assert msg.type.name in ("CLOSE", "CLOSED", "CLOSING")
+        finally:
+            await client.close()
+            await server.close()
+
+    asyncio.run(main())
+
+
+def test_ws_send_fault_injection_reaps_subscriber(tmp_path):
+    """An injected ws.send error behaves like a dead subscriber: the
+    broadcast reports one fewer delivery and the hub drops the conn."""
+    from upow_tpu.config import WsConfig
+    from upow_tpu.ws.hub import WsHub
+
+    async def main():
+        hub = WsHub(WsConfig(heartbeat_interval=1000.0))
+        app = web.Application()
+        app.router.add_get("/ws", hub.handle)
+        server = TestServer(app)
+        await server.start_server()
+        client = TestClient(server)
+        try:
+            ws = await client.ws_connect("/ws")
+            await ws.receive_json()          # connection_established
+            await ws.send_json({"type": "subscribe_block"})
+            assert (await ws.receive_json())["type"] == "success"
+            assert await hub.broadcast_new_block({"block_no": 1}) == 1
+            faultinject.install("ws.send:error", seed=0)
+            assert await hub.broadcast_new_block({"block_no": 2}) == 0
+            assert hub.get_stats()["total_connections"] == 0
+        finally:
+            faultinject.uninstall()
+            await client.close()
+            await server.close()
+
+    asyncio.run(main())

@@ -80,6 +80,39 @@ class DeviceConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Retry / circuit-breaker / degradation / fault-injection knobs.
+
+    Everything here is operational policy, not consensus: two nodes with
+    different resilience settings stay bit-identical on chain state.
+    Fault injection is OFF unless ``faults`` is non-empty, so production
+    code paths run unmodified by default.
+    """
+
+    # retry with jittered exponential backoff for outbound RPC
+    rpc_attempts: int = 3           # total tries per logical call
+    rpc_backoff_base: float = 0.25  # first retry delay (seconds)
+    rpc_backoff_max: float = 2.0    # per-retry delay ceiling
+    rpc_backoff_multiplier: float = 2.0
+    rpc_jitter: float = 0.5         # +/- fraction of each delay
+    rpc_deadline: float = 45.0      # total budget per logical call
+                                    # (attempts + backoffs); 0 = none
+    propagate_deadline: float = 10.0  # per-peer bound on gossip sends
+    # per-peer circuit breakers (PeerBook health scores)
+    breaker_failure_threshold: int = 5   # consecutive failures -> open
+    breaker_open_secs: float = 30.0      # open -> half-open probe delay
+    breaker_half_open_max: int = 1       # trial calls while half-open
+    # TPU -> CPU graceful degradation for the verify hot path
+    device_failure_limit: int = 3   # consecutive errors -> degraded
+    device_cooldown: float = 60.0   # degraded -> re-probe interval
+    # deterministic fault injection (resilience/faultinject.py); empty
+    # spec = disabled, hooks are inert.  Example:
+    #   "rpc:error:p=0.5;device.verify:error:times=3"
+    faults: str = ""
+    faults_seed: int = 0
+
+
+@dataclass
 class NodeConfig:
     host: str = "0.0.0.0"
     port: int = 3006                # reference run_node.py port
@@ -99,6 +132,10 @@ class NodeConfig:
     prune_after: int = 90 * 86400   # forget peers silent this long (:25)
     propagate_sample: int = 10      # sample size per class (:144-149)
     response_cap: int = 20 * 1024 * 1024  # streaming response cap (:79-86)
+    http_timeout: float = 30.0      # outbound RPC session total timeout
+                                    # (both session-creation sites: the
+                                    # node's shared pool and the lazy
+                                    # NodeInterface fallback)
     sync_reorg_window: int = 500    # main.py:167-185
     sync_page: int = 1000           # block download page (main.py:188-192)
     sync_fetch_interval: float = 1.7  # min seconds between get_blocks
@@ -123,6 +160,7 @@ class WsConfig:
     rate_limit_per_minute: int = 60
     heartbeat_interval: float = 30.0
     connection_expiry: float = 300.0
+    cleanup_interval: float = 60.0  # idle-expiry sweep period
     channels: tuple = ("block", "transaction")
 
 
@@ -151,6 +189,7 @@ class Config:
     ws: WsConfig = field(default_factory=WsConfig)
     miner: MinerConfig = field(default_factory=MinerConfig)
     log: LogConfig = field(default_factory=LogConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     @classmethod
     def load(cls, path: Optional[str] = None, **overrides) -> "Config":
@@ -190,7 +229,7 @@ def _merge_dict(cfg: Config, data: dict) -> Config:
 
 
 def _merge_env(cfg: Config) -> Config:
-    for section in ("device", "node", "ws", "miner", "log"):
+    for section in ("device", "node", "ws", "miner", "log", "resilience"):
         sub = getattr(cfg, section)
         for f in dataclasses.fields(sub):
             env = f"UPOW_{section.upper()}_{f.name.upper()}"

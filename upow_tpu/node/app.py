@@ -26,6 +26,7 @@ from typing import List, Optional
 
 from aiohttp import web
 
+from .. import trace
 from ..config import Config
 from ..core.constants import ENDIAN, MAX_SUPPLY, SMALLEST, VERSION
 from ..core.clock import timestamp
@@ -34,6 +35,7 @@ from ..core.header import block_to_bytes, split_block_content
 from ..core.merkle import merkle_root
 from ..core.tx import AmbiguousSignatureError, CoinbaseTx, Tx, tx_from_hex
 from ..logger import get_logger, setup_logging
+from ..resilience import (BreakerRegistry, ResilienceContext, faultinject)
 from ..state.storage import ChainState
 from ..verify.block import BlockManager
 from ..verify.txverify import TxVerifier, run_sig_checks_async
@@ -115,7 +117,21 @@ class Node:
             verify_pad_block=self.config.device.verify_pad_block,
             verify_device_timeout=self.config.device.verify_device_timeout,
             verify_mesh_devices=self.config.device.mesh_devices)
-        self.peers = PeerBook(self.config.node)
+        rcfg = self.config.resilience
+        self.breakers = BreakerRegistry(
+            failure_threshold=rcfg.breaker_failure_threshold,
+            open_secs=rcfg.breaker_open_secs,
+            half_open_max=rcfg.breaker_half_open_max)
+        if rcfg.faults:
+            faultinject.install(rcfg.faults, rcfg.faults_seed)
+        self.resilience = ResilienceContext.from_config(
+            rcfg, breakers=self.breakers)
+        # device degradation knobs land on the process-wide manager the
+        # verify dispatch consults (verify/txverify.py)
+        from ..verify.txverify import DEGRADE
+
+        DEGRADE.configure(rcfg.device_failure_limit, rcfg.device_cooldown)
+        self.peers = PeerBook(self.config.node, breakers=self.breakers)
         self.ip_filter = IpFilter(self.config.node.ip_config_file)
         from .ratelimit import RateLimiter
 
@@ -182,7 +198,8 @@ class Node:
 
         if self._http_session is None or self._http_session.closed:
             self._http_session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=30))
+                timeout=aiohttp.ClientTimeout(
+                    total=self.config.node.http_timeout))
         return self._http_session
 
     @staticmethod
@@ -223,19 +240,41 @@ class Node:
     async def propagate(self, path: str, args: dict,
                         ignore_url: Optional[str] = None,
                         nodes: Optional[List[str]] = None) -> None:
-        """Fan-out to the propagate set (main.py:79-94)."""
+        """Fan-out to the propagate set (main.py:79-94).
+
+        Each peer send is bounded by ``propagate_deadline`` so one hung
+        peer cannot stall gossip to everyone else; the fan-out itself
+        stays fully concurrent and a timed-out send only marks THAT
+        peer's breaker (via the RPC wrapper) and a counter."""
         self_base = _normalize(self.self_url)
         ignore_base = _normalize(ignore_url or "")
+        deadline = self.config.resilience.propagate_deadline
         aws = []
         session = self._session()
         for node_url in nodes if nodes is not None else self.peers.propagate_nodes():
-            iface = NodeInterface(node_url, self.config.node, session=session)
+            iface = NodeInterface(node_url, self.config.node, session=session,
+                                  resilience=self.resilience)
             if iface.base_url in (self_base, ignore_base):
                 continue
-            aws.append(iface.request(path, args, self_base))
-        for resp in await asyncio.gather(*aws, return_exceptions=True):
-            if isinstance(resp, Exception):
-                log.debug("propagate error: %s", resp)
+            aws.append(self._propagate_one(iface, path, args, self_base,
+                                           deadline))
+        await asyncio.gather(*aws)
+
+    async def _propagate_one(self, iface: NodeInterface, path: str,
+                             args: dict, self_base: str,
+                             deadline: float) -> None:
+        try:
+            await asyncio.wait_for(iface.request(path, args, self_base),
+                                   deadline or None)
+        except asyncio.TimeoutError:
+            trace.inc("resilience.propagate_timeouts")
+            # the wrapper's breaker bookkeeping never ran (cancelled
+            # mid-attempt) — a hang is the strongest failure signal
+            self.breakers.record_failure(iface.base_url)
+            log.debug("propagate to %s timed out after %.1fs",
+                      iface.base_url, deadline)
+        except Exception as e:
+            log.debug("propagate error: %s", e)
 
     async def _propagate_old_transactions(self) -> None:
         txs = await self.state.get_need_propagate_transactions()
@@ -314,7 +353,8 @@ class Node:
             if not seeds:
                 return
             iface = NodeInterface(seeds[0], self.config.node,
-                                  session=self._session())
+                                  session=self._session(),
+                                  resilience=self.resilience)
             for url in await iface.get_nodes():
                 self.peers.add(url)
             self.peers.remove(self.self_url)
@@ -413,22 +453,21 @@ class Node:
         """Health probe (reference main.py:266-275) + additive timing
         stats from the span registry (trace.py) — same shape the
         reference's required keys take, extra key ignored by peers."""
-        from ..trace import stats
-
         fingerprint = await self.state.get_unspent_outputs_hash()
         return web.json_response({
             "ok": True, "version": VERSION,
             "unspent_outputs_hash": fingerprint,
-            "timings": stats(),
+            "timings": trace.stats(),
+            "counters": trace.counters(),
         })
 
     async def h_metrics(self, request: web.Request) -> web.Response:
         """Prometheus text exposition — beyond-reference observability
         (SURVEY §5 notes the reference has "No Prometheus/StatsD").
         Gauges for chain/mempool/peer/WS state plus the span registry as
-        per-section count/total/max series."""
-        from ..trace import stats
-
+        per-section count/total/max series, resilience event counters
+        (``upow_<name>_total``), per-state breaker counts, and the
+        device-verify health gauge."""
         lines = []
 
         def gauge(name, value, help_text):
@@ -465,7 +504,17 @@ class Node:
                   "Open WebSocket push connections")
             gauge("upow_ws_messages_out", ws["messages_out"],
                   "WebSocket messages delivered")
-        for name, s in sorted(stats().items()):
+        for state_name, count in sorted(self.breakers.state_counts().items()):
+            gauge(f"upow_breaker_{state_name}_peers", count,
+                  f"Peers whose circuit breaker is {state_name}")
+        gauge("upow_device_verify_health",
+              self.manager.device_health()["gauge"],
+              "Device verify path: 0=ok 1=degraded(CPU) 2=poisoned")
+        for name, value in sorted(trace.counters().items()):
+            safe = name.replace(".", "_").replace("-", "_")
+            lines.append(f"# TYPE upow_{safe}_total counter")
+            lines.append(f"upow_{safe}_total {value}")
+        for name, s in sorted(trace.stats().items()):
             safe = name.replace(".", "_").replace("-", "_")
             lines.append(f"# TYPE upow_span_{safe}_count counter")
             lines.append(f"upow_span_{safe}_count {s['count']}")
@@ -629,11 +678,12 @@ class Node:
                 {"ok": False, "error": "Node is already syncing"})
         node_url = request.rel_url.query.get("node_url")
         resp = await self.sync_blockchain(node_url)
-        if isinstance(resp, str):
-            return web.json_response({"ok": False, "error": resp})
-        if isinstance(resp, Exception):
-            return web.json_response({"ok": False, "error": str(resp)})
-        return web.json_response({"ok": bool(resp)})
+        body = {"ok": resp["ok"]}
+        if not resp["ok"]:
+            body["error"] = resp["error"]
+        if resp["peer"]:
+            body["peer"] = resp["peer"]  # additive: which source was used
+        return web.json_response(body)
 
     async def h_get_mining_info(self, request: web.Request) -> web.Response:
         return web.json_response(
@@ -800,6 +850,9 @@ class Node:
         if self.peers.contains(url):
             return web.json_response(
                 {"ok": False, "error": "Node already present"})
+        # no resilience ctx: the probe of a candidate peer should stay a
+        # quick single attempt and not seed a breaker entry for a URL we
+        # may never admit to the book
         iface = NodeInterface(url, self.config.node, session=self._session())
         try:
             await iface.get("")
@@ -933,39 +986,60 @@ class Node:
         return web.json_response(result)
 
     # ------------------------------------------------------------ sync ----
-    async def sync_blockchain(self, node_url: Optional[str] = None):
-        """Guarded wrapper (main.py:230-243).  When no peer is named, up
-        to 3 distinct peers are tried before giving up — the reference
-        picks ONE random peer per call (main.py:158-166), so a single
-        dead seed (or its own unreachable CORE_URL default) makes that
-        sync attempt a no-op even with healthy peers in the book."""
+    @staticmethod
+    def _sync_result(outcome, peer: Optional[str]) -> dict:
+        """Normalize _sync_blockchain's True|str|Exception outcome into
+        the structured {ok, error, peer} shape — callers (and the HTTP
+        handler) never see a raw exception object."""
+        if outcome is True:
+            return {"ok": True, "error": None, "peer": peer}
+        if isinstance(outcome, BaseException):
+            error = f"{type(outcome).__name__}: {outcome}"
+        else:
+            error = str(outcome)
+        return {"ok": False, "error": error, "peer": peer}
+
+    async def sync_blockchain(self, node_url: Optional[str] = None) -> dict:
+        """Guarded wrapper (main.py:230-243) returning a structured
+        ``{ok, error, peer}`` dict.  When no peer is named, up to 3
+        distinct peers are tried before giving up — the reference picks
+        ONE random peer per call (main.py:158-166), so a single dead
+        seed (or its own unreachable CORE_URL default) makes that sync
+        attempt a no-op even with healthy peers in the book.  The
+        sampled candidates are then ordered by breaker health so a peer
+        that has been failing all day is the LAST one tried, not an
+        equal-odds first pick."""
         if self.is_syncing:
-            return "Node is already syncing"
+            return self._sync_result("Node is already syncing", None)
         self.is_syncing = True
         self.manager.is_syncing = True
         try:
             if node_url:
-                return await self._sync_blockchain(node_url)
+                return self._sync_result(
+                    await self._sync_blockchain(node_url), node_url)
             nodes = self.peers.recent_nodes()
             if not nodes:
-                return "No nodes found."
-            result = None
-            for url in random.sample(nodes, min(3, len(nodes))):
+                return self._sync_result("No nodes found.", None)
+            result = self._sync_result("no peers tried", None)
+            candidates = random.sample(nodes, min(3, len(nodes)))
+            for url in self.peers.ranked(candidates):
                 try:
-                    result = await self._sync_blockchain(url)
+                    outcome = await self._sync_blockchain(url)
                 except Exception as e:
                     # a dead peer raises from the fork-detection fetches
                     # before the paged loop's own error handling — it
                     # must advance the retry, not abort it
-                    result = e
-                if result is True:
-                    return True
-                log.info("sync from %s did not complete (%s); trying "
-                         "another peer", url, result)
+                    outcome = e
+                result = self._sync_result(outcome, url)
+                if result["ok"]:
+                    return result
+                log.warning("sync from %s did not complete (%s); trying "
+                            "another peer", url, result["error"])
             return result
         except Exception as e:
-            log.error("sync_blockchain error: %s", e)
-            return e
+            log.warning("sync_blockchain error: %s: %s",
+                        type(e).__name__, e)
+            return self._sync_result(e, node_url)
         finally:
             self.is_syncing = False
             self.manager.is_syncing = False
@@ -974,7 +1048,8 @@ class Node:
         """Fork detection + paged download (main.py:153-227), against one
         named peer."""
         cfg = self.config.node
-        iface = NodeInterface(node_url, cfg, session=self._session())
+        iface = NodeInterface(node_url, cfg, session=self._session(),
+                              resilience=self.resilience)
         prefetch: Optional[asyncio.Task] = None
         prefetch_from = None
         try:

@@ -8,10 +8,19 @@ header as the return address and responses are capped at 20 MB.
 
 Transport is aiohttp (the reference uses httpx) — one shared session per
 process, created lazily on the running loop.
+
+Resilience: every :class:`NodeInterface` RPC can run under a
+:class:`~upow_tpu.resilience.ResilienceContext` — per-peer circuit
+breaker gate, deterministic fault injection, then retry with jittered
+backoff under a total deadline.  Without a context (standalone clients,
+older tests) behaviour is exactly the single-attempt original.  The
+:class:`PeerBook` carries the breaker registry so gossip/sync peer
+selection can skip open circuits and prefer high-score peers.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import os
 import random
@@ -23,6 +32,14 @@ from filelock import FileLock
 
 from ..config import NodeConfig
 from ..logger import get_logger
+from .. import trace
+from ..resilience import (BreakerRegistry, CircuitOpenError,
+                          ResilienceContext, call_with_retry, faultinject)
+
+# Exceptions worth retrying: transport-level trouble, not peer-side
+# application errors (an HTTP error body parses fine and is NOT retried).
+TRANSIENT_ERRORS = (aiohttp.ClientError, asyncio.TimeoutError,
+                    ConnectionError, OSError)
 
 log = get_logger("peers")
 
@@ -37,8 +54,13 @@ def _normalize(url: str) -> str:
 class PeerBook:
     """Durable peer registry with active/unseen classes and pruning."""
 
-    def __init__(self, cfg: Optional[NodeConfig] = None):
+    def __init__(self, cfg: Optional[NodeConfig] = None,
+                 breakers: Optional[BreakerRegistry] = None):
         self.cfg = cfg or NodeConfig()
+        # Health scores for selection; a default registry keeps
+        # standalone PeerBooks working with every peer reading healthy.
+        self.breakers = breakers if breakers is not None else \
+            BreakerRegistry()
         self.path = self.cfg.peers_file
         self._lock = FileLock(self.path + ".lock") if self.path else None
         self._data: Dict[str, dict] = {}
@@ -117,12 +139,27 @@ class PeerBook:
         ]
         return active or list(self._data)
 
+    def _healthy_sample(self, pool: List[str], k: int) -> List[str]:
+        """Sample ``k`` peers, skipping open circuits and preferring the
+        high-score tier.  With no breaker history every peer scores 1.0
+        and this is exactly the reference's ``random.sample``."""
+        pool = [u for u in pool if self.breakers.usable(u)]
+        good = [u for u in pool if self.breakers.score(u) >= 0.5]
+        weak = [u for u in pool if self.breakers.score(u) < 0.5]
+        picks = random.sample(good, min(k, len(good)))
+        if len(picks) < k:
+            picks += random.sample(weak, min(k - len(picks), len(weak)))
+        return picks
+
     def propagate_nodes(self) -> List[str]:
-        """≤10 random active + ≤10 random never-seen (nodes_manager.py:144-149).
+        """≤10 active + ≤10 never-seen (nodes_manager.py:144-149), healthy
+        first.
 
         "Active" is the 7-day window (the reference samples
         get_recent_nodes here): a peer last heard from BEYOND the window
-        is neither active nor never-seen and is not gossiped to."""
+        is neither active nor never-seen and is not gossiped to.  On top
+        of the reference semantics, peers whose circuit is open are
+        skipped and degraded-score peers only fill leftover slots."""
         k = self.cfg.propagate_sample
         now = time.time()
         active = [
@@ -132,9 +169,16 @@ class PeerBook:
         ]
         unseen = [u for u, meta in self._data.items()
                   if meta.get("last_message", 0) == 0]
-        picks = random.sample(active, min(k, len(active)))
-        picks += random.sample(unseen, min(k, len(unseen)))
+        picks = self._healthy_sample(active, k)
+        picks += self._healthy_sample(unseen, k)
         return picks
+
+    def ranked(self, urls: List[str]) -> List[str]:
+        """Stable-sort candidate peers by descending health score with
+        open circuits pushed to the back (sync source ordering)."""
+        return sorted(urls, key=lambda u: (
+            0 if self.breakers.usable(u) else 1,
+            -self.breakers.score(u)))
 
     def contains(self, url: str) -> bool:
         return _normalize(url) in self._data
@@ -144,17 +188,19 @@ class NodeInterface:
     """RPC client for one remote node (nodes_manager.py:174-210)."""
 
     def __init__(self, url: str, cfg: Optional[NodeConfig] = None,
-                 session: Optional[aiohttp.ClientSession] = None):
+                 session: Optional[aiohttp.ClientSession] = None,
+                 resilience: Optional[ResilienceContext] = None):
         self.base_url = _normalize(url)
         self.url = self.base_url
         self.cfg = cfg or NodeConfig()
         self._session = session
         self._own_session = session is None  # close() only closes what we made
+        self._resilience = resilience
 
     async def _get_session(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
             self._session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=30))
+                timeout=aiohttp.ClientTimeout(total=self.cfg.http_timeout))
             self._own_session = True
         return self._session
 
@@ -171,30 +217,72 @@ class NodeInterface:
                 raise ValueError("response too large")
         return json.loads(buf or b"{}")
 
+    async def _resilient(self, attempt, label: str):
+        """Run one RPC attempt factory under the breaker → fault-injection
+        → retry stack.  Without a ResilienceContext this is a transparent
+        single attempt (standalone clients keep the original behaviour)."""
+        ctx = self._resilience
+        if ctx is None:
+            return await attempt()
+        breaker = ctx.breakers.get(self.base_url)
+        if not breaker.available():
+            trace.inc("resilience.breaker_rejected")
+            raise CircuitOpenError(self.base_url)
+
+        async def guarded():
+            injector = faultinject.get_injector()
+            if injector is not None:
+                await injector.fire(f"rpc.{label}", self.base_url)
+            return await attempt()
+
+        def on_retry(exc, retry_no):
+            trace.inc("resilience.rpc_retries")
+            log.debug("retry %d for %s %s: %s", retry_no, self.base_url,
+                      label, exc)
+
+        try:
+            out = await call_with_retry(
+                guarded, ctx.policy, retry_on=TRANSIENT_ERRORS,
+                rng=ctx.rng, on_retry=on_retry)
+        except TRANSIENT_ERRORS:
+            breaker.record_failure()
+            raise
+        breaker.record_success()
+        return out
+
     async def request(self, path: str, args: dict,
                       sender_node: str = "") -> dict:
         """Wire-compatible RPC: POST json for push_block/push_tx, GET with
         query params for everything else (reference
         nodes_manager.py:192-209) — so e.g. gossiped ``add_node`` lands on
         peers' GET routes."""
-        session = await self._get_session()
         headers = {"Sender-Node": sender_node} if sender_node else {}
-        if path in ("push_block", "push_tx"):
-            async with session.post(f"{self.base_url}/{path}", json=args,
-                                    headers=headers) as resp:
+
+        async def attempt() -> dict:
+            session = await self._get_session()
+            if path in ("push_block", "push_tx"):
+                async with session.post(f"{self.base_url}/{path}",
+                                        json=args, headers=headers) as resp:
+                    return await self._read_capped(resp)
+            params = {k: str(v) for k, v in args.items()}
+            async with session.get(f"{self.base_url}/{path}", params=params,
+                                   headers=headers) as resp:
                 return await self._read_capped(resp)
-        params = {k: str(v) for k, v in args.items()}
-        async with session.get(f"{self.base_url}/{path}", params=params,
-                               headers=headers) as resp:
-            return await self._read_capped(resp)
+
+        return await self._resilient(attempt, path)
 
     async def get(self, path: str, params: Optional[dict] = None,
                   sender_node: str = "") -> dict:
-        session = await self._get_session()
         headers = {"Sender-Node": sender_node} if sender_node else {}
-        async with session.get(f"{self.base_url}/{path}",
-                               params=params or {}, headers=headers) as resp:
-            return await self._read_capped(resp)
+
+        async def attempt() -> dict:
+            session = await self._get_session()
+            async with session.get(f"{self.base_url}/{path}",
+                                   params=params or {},
+                                   headers=headers) as resp:
+                return await self._read_capped(resp)
+
+        return await self._resilient(attempt, path)
 
     @staticmethod
     def _result(res: dict):

@@ -1,0 +1,63 @@
+"""Resilience layer: retry/backoff, circuit breakers, TPU degradation,
+and deterministic fault injection.
+
+Four independent pieces (policy, breaker, degrade, faultinject) plus the
+:class:`ResilienceContext` glue that the node builds once from
+``ResilienceConfig`` and hands to every :class:`NodeInterface`.  Nothing
+in here touches consensus state — two nodes with different resilience
+settings stay bit-identical on chain.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Optional
+
+from .breaker import (BreakerRegistry, CircuitBreaker, CircuitOpenError,
+                      CLOSED, HALF_OPEN, OPEN)
+from .degrade import DegradeManager
+from .faultinject import (FaultInjected, FaultInjector, get_injector,
+                          install, uninstall)
+from .policy import DeadlineExceeded, RetryPolicy, call_with_retry
+
+__all__ = [
+    "BreakerRegistry", "CircuitBreaker", "CircuitOpenError",
+    "CLOSED", "HALF_OPEN", "OPEN",
+    "DegradeManager",
+    "FaultInjected", "FaultInjector", "get_injector", "install",
+    "uninstall",
+    "DeadlineExceeded", "RetryPolicy", "call_with_retry",
+    "ResilienceContext",
+]
+
+
+@dataclass
+class ResilienceContext:
+    """Everything an outbound-RPC wrapper needs, built once per node."""
+
+    policy: RetryPolicy
+    breakers: BreakerRegistry
+    injector: Optional[FaultInjector] = None
+    rng: Optional[random.Random] = None
+
+    @classmethod
+    def from_config(cls, rcfg, breakers: Optional[BreakerRegistry] = None,
+                    injector: Optional[FaultInjector] = None
+                    ) -> "ResilienceContext":
+        policy = RetryPolicy(
+            attempts=rcfg.rpc_attempts,
+            base_delay=rcfg.rpc_backoff_base,
+            max_delay=rcfg.rpc_backoff_max,
+            multiplier=rcfg.rpc_backoff_multiplier,
+            jitter=rcfg.rpc_jitter,
+            deadline=rcfg.rpc_deadline,
+        )
+        if breakers is None:
+            breakers = BreakerRegistry(
+                failure_threshold=rcfg.breaker_failure_threshold,
+                open_secs=rcfg.breaker_open_secs,
+                half_open_max=rcfg.breaker_half_open_max,
+            )
+        return cls(policy=policy, breakers=breakers, injector=injector,
+                   rng=random.Random(rcfg.faults_seed))

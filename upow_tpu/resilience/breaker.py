@@ -1,0 +1,175 @@
+"""Per-peer circuit breakers + health scores for the gossip/sync planes.
+
+Classic three-state breaker per peer URL:
+
+* **closed** — requests flow; ``failure_threshold`` consecutive failures
+  trip it open.
+* **open** — requests are refused locally (``CircuitOpenError``) for
+  ``open_secs``; the peer costs nothing while it is down.
+* **half-open** — after ``open_secs`` the next ``half_open_max`` calls
+  are let through as trials: one success closes the breaker, one failure
+  re-opens it for another ``open_secs``.
+
+Alongside the state machine each breaker keeps an EWMA **health score**
+in [0, 1] (1 = every recent call succeeded).  The :class:`PeerBook` uses
+scores to prefer healthy peers for gossip fan-out and sync source
+selection, and the ``/metrics`` endpoint exports per-state counts.
+
+The clock is injectable so tests drive open→half-open transitions
+without sleeping.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+_SCORE_ALPHA = 0.3  # EWMA weight of the newest observation
+
+
+class CircuitOpenError(ConnectionError):
+    """Raised locally instead of contacting a peer whose circuit is open."""
+
+    def __init__(self, key: str):
+        super().__init__(f"circuit open for {key}")
+        self.key = key
+
+
+class CircuitBreaker:
+    """One peer's breaker state + health score."""
+
+    def __init__(self, failure_threshold: int = 5, open_secs: float = 30.0,
+                 half_open_max: int = 1,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = failure_threshold
+        self.open_secs = open_secs
+        self.half_open_max = half_open_max
+        self._clock = clock
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._half_open_trials = 0
+        self.score = 1.0
+        self.transitions: List[str] = [CLOSED]  # observable cycle history
+
+    # ---------------------------------------------------------- state ----
+    @property
+    def state(self) -> str:
+        """Current state, applying the time-based open→half-open move."""
+        if self._state == OPEN and \
+                self._clock() - self._opened_at >= self.open_secs:
+            self._set_state(HALF_OPEN)
+            self._half_open_trials = 0
+        return self._state
+
+    def _set_state(self, state: str) -> None:
+        if state != self._state:
+            self._state = state
+            self.transitions.append(state)
+
+    def available(self) -> bool:
+        """May a request be sent now?  Half-open admits up to
+        ``half_open_max`` concurrent trials (accounted per call here —
+        a refused trial does not consume a slot)."""
+        state = self.state
+        if state == CLOSED:
+            return True
+        if state == HALF_OPEN:
+            if self._half_open_trials < self.half_open_max:
+                self._half_open_trials += 1
+                return True
+            return False
+        return False
+
+    def usable(self) -> bool:
+        """Non-consuming peek for peer *selection*: open = skip, closed
+        or half-open = a candidate.  Unlike :meth:`available` this never
+        spends a half-open trial slot."""
+        return self.state != OPEN
+
+    # -------------------------------------------------------- outcomes ----
+    def record_success(self) -> None:
+        self._consecutive_failures = 0
+        self.score += _SCORE_ALPHA * (1.0 - self.score)
+        if self.state == HALF_OPEN:
+            self._set_state(CLOSED)
+
+    def record_failure(self) -> None:
+        self._consecutive_failures += 1
+        self.score -= _SCORE_ALPHA * self.score
+        state = self.state
+        if state == HALF_OPEN or (
+                state == CLOSED and
+                self._consecutive_failures >= self.failure_threshold):
+            self._set_state(OPEN)
+            self._opened_at = self._clock()
+
+    def snapshot(self) -> dict:
+        return {"state": self.state, "score": round(self.score, 4),
+                "consecutive_failures": self._consecutive_failures}
+
+
+class BreakerRegistry:
+    """Breakers keyed by peer URL, created on first touch.
+
+    Thread-safe on the registry dict only: individual breakers are
+    mutated from the event loop, which is single-threaded per node.
+    Unknown peers read as healthy (score 1.0, available) so a fresh
+    peer book behaves exactly as before the resilience layer existed.
+    """
+
+    def __init__(self, failure_threshold: int = 5, open_secs: float = 30.0,
+                 half_open_max: int = 1,
+                 clock: Callable[[], float] = time.monotonic):
+        self._kw = dict(failure_threshold=failure_threshold,
+                        open_secs=open_secs, half_open_max=half_open_max)
+        self._clock = clock
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: str) -> CircuitBreaker:
+        with self._lock:
+            breaker = self._breakers.get(key)
+            if breaker is None:
+                breaker = CircuitBreaker(clock=self._clock, **self._kw)
+                self._breakers[key] = breaker
+            return breaker
+
+    def peek(self, key: str) -> Optional[CircuitBreaker]:
+        return self._breakers.get(key)
+
+    # ------------------------------------------------------- delegation ---
+    def available(self, key: str) -> bool:
+        breaker = self.peek(key)
+        return True if breaker is None else breaker.available()
+
+    def usable(self, key: str) -> bool:
+        breaker = self.peek(key)
+        return True if breaker is None else breaker.usable()
+
+    def score(self, key: str) -> float:
+        breaker = self.peek(key)
+        return 1.0 if breaker is None else breaker.score
+
+    def record_success(self, key: str) -> None:
+        self.get(key).record_success()
+
+    def record_failure(self, key: str) -> None:
+        self.get(key).record_failure()
+
+    # ------------------------------------------------------------ views ---
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            items = list(self._breakers.items())
+        return {key: b.snapshot() for key, b in items}
+
+    def state_counts(self) -> Dict[str, int]:
+        counts = {CLOSED: 0, OPEN: 0, HALF_OPEN: 0}
+        for snap in self.snapshot().values():
+            counts[snap["state"]] += 1
+        return counts

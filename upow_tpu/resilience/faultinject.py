@@ -1,0 +1,184 @@
+"""Seeded, deterministic fault injection for the chaos suite.
+
+A :class:`FaultInjector` holds a list of :class:`Fault` rules parsed
+from a compact spec string (``ResilienceConfig.faults`` or
+``UPOW_RESILIENCE_FAULTS``)::
+
+    site:kind[:k=v,...][;site:kind...]
+
+    rpc:error:p=0.5,key=9001        every other RPC to a :9001 peer errors
+    device.verify:error:times=3     first three device verifies error
+    ws.send:latency:delay=0.2       every ws send stalls 200 ms
+    rpc:hang:times=1,delay=30       one RPC hangs 30 s (deadline food)
+
+Sites are prefix-matched (``rpc`` matches ``rpc.get_blocks``); ``key``
+substring-filters the per-call key (usually the peer URL).  ``kind`` is
+``error`` (raise :class:`FaultInjected`), ``latency`` (sleep ``delay``
+then proceed) or ``hang`` (sleep ``delay``, default far beyond any
+deadline, then raise).  ``p`` draws from ONE seeded ``random.Random``
+so a fixed ``faults_seed`` replays the exact fault schedule; ``times``
+caps how often a rule fires (-1 = unlimited).
+
+Production stance: the hooks in peers.py / hub.py / txverify.py call
+:func:`get_injector` which returns ``None`` unless :func:`install` ran
+with a non-empty spec — the disabled cost is one module attribute read.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..logger import get_logger
+
+log = get_logger("faultinject")
+
+KINDS = ("error", "latency", "hang")
+_HANG_DEFAULT = 3600.0  # beyond any sane deadline; boxed/wait_for food
+
+
+class FaultInjected(ConnectionError):
+    """An injected failure.  Subclasses ConnectionError so the retry and
+    breaker layers treat it exactly like a real transport fault."""
+
+    def __init__(self, site: str, key: str = ""):
+        super().__init__(f"injected fault at {site}"
+                         + (f" ({key})" if key else ""))
+        self.site = site
+
+
+@dataclass
+class Fault:
+    site: str                   # prefix match against the fire() site
+    kind: str                   # error | latency | hang
+    p: float = 1.0              # fire probability per matching call
+    times: int = -1             # max fires (-1 = unlimited)
+    delay: float = 0.0          # latency/hang sleep (hang defaults 3600)
+    key: str = ""               # substring filter on the per-call key
+    fired: int = 0              # observability: how often it has fired
+
+    def matches(self, site: str, key: str) -> bool:
+        if self.times >= 0 and self.fired >= self.times:
+            return False
+        if not (site == self.site or site.startswith(self.site + ".")):
+            return False
+        return self.key in key if self.key else True
+
+
+def parse_spec(spec: str) -> List[Fault]:
+    faults = []
+    for part in filter(None, (p.strip() for p in spec.split(";"))):
+        bits = part.split(":", 2)
+        if len(bits) < 2:
+            raise ValueError(f"fault spec {part!r}: want site:kind[:k=v,..]")
+        site, kind = bits[0], bits[1]
+        if kind not in KINDS:
+            raise ValueError(f"fault kind {kind!r} not in {KINDS}")
+        kwargs: Dict[str, object] = {}
+        if len(bits) == 3 and bits[2]:
+            for pair in bits[2].split(","):
+                name, _, raw = pair.partition("=")
+                if name == "p":
+                    kwargs["p"] = float(raw)
+                elif name == "times":
+                    kwargs["times"] = int(raw)
+                elif name == "delay":
+                    kwargs["delay"] = float(raw)
+                elif name == "key":
+                    kwargs["key"] = raw
+                else:
+                    raise ValueError(f"fault spec {part!r}: unknown "
+                                     f"option {name!r}")
+        fault = Fault(site=site, kind=kind, **kwargs)
+        if fault.kind == "hang" and not fault.delay:
+            fault.delay = _HANG_DEFAULT
+        faults.append(fault)
+    return faults
+
+
+class FaultInjector:
+    """Evaluates fault rules at named sites, deterministically."""
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.faults = parse_spec(spec)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def _pick(self, site: str, key: str) -> Optional[Fault]:
+        with self._lock:
+            for fault in self.faults:
+                if fault.matches(site, key) and \
+                        (fault.p >= 1.0 or self._rng.random() < fault.p):
+                    fault.fired += 1
+                    return fault
+        return None
+
+    async def fire(self, site: str, key: str = "") -> None:
+        """Async injection point: sleep and/or raise per the first
+        matching armed rule.  No-op when nothing matches."""
+        fault = self._pick(site, key)
+        if fault is None:
+            return
+        self._count(fault, site, key)
+        if fault.kind == "latency":
+            await asyncio.sleep(fault.delay)
+            return
+        if fault.kind == "hang":
+            await asyncio.sleep(fault.delay)
+        raise FaultInjected(site, key)
+
+    def fire_sync(self, site: str, key: str = "") -> None:
+        """Blocking injection point for executor-thread sites
+        (device.verify runs inside boxed_call's worker thread — a hang
+        here is exactly what the box is designed to absorb)."""
+        fault = self._pick(site, key)
+        if fault is None:
+            return
+        self._count(fault, site, key)
+        if fault.kind == "latency":
+            time.sleep(fault.delay)
+            return
+        if fault.kind == "hang":
+            time.sleep(fault.delay)
+        raise FaultInjected(site, key)
+
+    def _count(self, fault: Fault, site: str, key: str) -> None:
+        from .. import trace
+
+        trace.inc("resilience.faults_injected")
+        log.info("fault injected: %s at %s key=%s (fire #%d)",
+                 fault.kind, site, key or "-", fault.fired)
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [{"site": f.site, "kind": f.kind, "fired": f.fired,
+                     "times": f.times} for f in self.faults]
+
+
+# ---------------------------------------------------------------- global ---
+# One injector per process, None when disabled.  Hooks read this via
+# get_injector(); tests install/uninstall around each scenario.
+_injector: Optional[FaultInjector] = None
+
+
+def install(spec: str, seed: int = 0) -> Optional[FaultInjector]:
+    """Install a process-wide injector; empty spec uninstalls."""
+    global _injector
+    _injector = FaultInjector(spec, seed) if spec else None
+    if _injector is not None:
+        log.warning("fault injection ACTIVE: %s (seed=%d)", spec, seed)
+    return _injector
+
+
+def uninstall() -> None:
+    global _injector
+    _injector = None
+
+
+def get_injector() -> Optional[FaultInjector]:
+    return _injector

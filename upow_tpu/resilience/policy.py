@@ -1,0 +1,90 @@
+"""Retry with jittered exponential backoff under a total deadline budget.
+
+One policy object describes how a *logical* call may be retried:
+``attempts`` tries, exponentially spaced (``base_delay`` ×
+``multiplier``^n, capped at ``max_delay``), each delay jittered ±
+``jitter`` so a fleet of nodes retrying the same dead peer does not
+synchronize into thundering herds.  ``deadline`` bounds the WHOLE call —
+attempts plus backoffs — so a retried RPC can never exceed its budget no
+matter how the per-attempt transport timeouts land.
+
+Determinism: all randomness flows through an injectable ``random.Random``
+(the chaos suite pins it), and time/sleep are injectable for unit tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple, Type
+
+_DEFAULT_RNG = random.Random()
+
+
+class DeadlineExceeded(TimeoutError):
+    """The policy's total deadline ran out (before or between attempts)."""
+
+
+@dataclass
+class RetryPolicy:
+    attempts: int = 3           # total tries (1 = no retry)
+    base_delay: float = 0.25    # delay before the first retry
+    max_delay: float = 2.0      # per-delay ceiling
+    multiplier: float = 2.0     # exponential growth factor
+    jitter: float = 0.5         # each delay scaled by [1-j, 1+j]
+    deadline: float = 45.0      # total budget in seconds; 0 = unbounded
+
+    def delay_for(self, retry_no: int, rng: Optional[random.Random] = None
+                  ) -> float:
+        """Backoff before retry ``retry_no`` (1-based), jittered."""
+        delay = min(self.max_delay,
+                    self.base_delay * self.multiplier ** (retry_no - 1))
+        if self.jitter:
+            rng = rng or _DEFAULT_RNG
+            delay *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+        return max(0.0, delay)
+
+
+async def call_with_retry(fn: Callable, policy: RetryPolicy, *,
+                          retry_on: Tuple[Type[BaseException], ...] = (
+                              Exception,),
+                          rng: Optional[random.Random] = None,
+                          on_retry: Optional[Callable] = None,
+                          clock: Callable[[], float] = time.monotonic,
+                          sleep: Callable = asyncio.sleep):
+    """Await ``fn()`` with the policy's retry/backoff/deadline semantics.
+
+    ``fn`` is a zero-arg coroutine *factory* (each attempt gets a fresh
+    coroutine).  Each attempt is bounded by the remaining deadline via
+    ``asyncio.wait_for``; exceptions not in ``retry_on`` propagate
+    immediately.  ``on_retry(exc, retry_no)`` fires before each backoff
+    sleep (metrics hook).
+    """
+    start = clock()
+    retry_no = 0
+    while True:
+        remaining = None
+        if policy.deadline:
+            remaining = policy.deadline - (clock() - start)
+            if remaining <= 0:
+                raise DeadlineExceeded(
+                    f"retry deadline {policy.deadline}s exceeded")
+        try:
+            if remaining is not None:
+                return await asyncio.wait_for(fn(), remaining)
+            return await fn()
+        except retry_on as e:
+            retry_no += 1
+            if retry_no >= policy.attempts:
+                raise
+            delay = policy.delay_for(retry_no, rng)
+            if policy.deadline:
+                budget = policy.deadline - (clock() - start)
+                if budget <= 0:
+                    raise
+                delay = min(delay, budget)
+            if on_retry is not None:
+                on_retry(e, retry_no)
+            await sleep(delay)

@@ -11,6 +11,9 @@ around block creation (manager.py:655, 732-736) and UTXO deletes
 * :func:`profile` — wraps ``jax.profiler.trace`` so a kernel section
   can be captured for xprof/tensorboard when a trace dir is configured;
   a no-op otherwise (profiling must never take the node down).
+* :func:`inc` / :func:`counters` — process-wide event counters (retries,
+  breaker trips, device degradations, injected faults) exported on
+  ``/metrics`` as ``upow_<name>_total`` and asserted by the chaos suite.
 """
 
 from __future__ import annotations
@@ -26,6 +29,8 @@ log = get_logger("trace")
 
 _stats: Dict[str, dict] = defaultdict(
     lambda: {"count": 0, "total_s": 0.0, "max_s": 0.0})
+
+_counters: Dict[str, int] = defaultdict(int)
 
 
 @contextmanager
@@ -49,8 +54,23 @@ def stats() -> Dict[str, dict]:
     return {k: dict(v) for k, v in _stats.items()}
 
 
+def inc(name: str, n: int = 1) -> None:
+    """Bump a process-wide event counter (resilience/chaos observability).
+
+    Called from the event loop and executor threads; unlocked because a
+    lost increment under a rare interleave only skews an observability
+    counter, never chain state."""
+    _counters[name] += n
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of event counters: {name: count}."""
+    return dict(_counters)
+
+
 def reset() -> None:
     _stats.clear()
+    _counters.clear()
 
 
 @contextmanager

@@ -104,6 +104,15 @@ class BlockManager:
     def invalidate_difficulty(self):
         self._difficulty_cache = None
 
+    @staticmethod
+    def device_health() -> dict:
+        """Snapshot of the verify device path's degradation state
+        (txverify.DEGRADE) — the node's /metrics reads it through the
+        manager so the HTTP layer never imports verify internals."""
+        from .txverify import DEGRADE
+
+        return {**DEGRADE.snapshot(), "gauge": DEGRADE.state_gauge()}
+
     # -------------------------------------------------------- difficulty --
 
     async def calculate_difficulty(self) -> Tuple[Decimal, dict]:

@@ -72,9 +72,15 @@ def _dedup_sig_checks(tx: Tx, voter: bool,
     return checks
 
 
-_DEVICE_POISONED = False  # set when the accelerator hung / kept failing
-_DEVICE_FAILURES = 0  # consecutive device-dispatch failures
-_DEVICE_FAILURE_LIMIT = 3
+# Device-path health for the verify hot path: one process-wide state
+# machine (ok / degraded-with-cooldown / poisoned) replacing the old
+# one-way _DEVICE_POISONED flag.  Errors now degrade with periodic
+# re-probes; hangs still poison permanently (the stuck daemon thread
+# cannot be reclaimed).  The node pushes its configured failure_limit /
+# cooldown in via DEGRADE.configure() at startup.
+from ..resilience.degrade import DegradeManager, POISONED as _POISONED
+
+DEGRADE = DegradeManager()
 
 
 def _device_usable() -> bool:
@@ -87,14 +93,13 @@ def _device_usable() -> bool:
     process-wide thread-boxed probe (benchutil), and a hang poisons the
     device path for the life of the process (the stuck thread cannot be
     recovered)."""
-    global _DEVICE_POISONED
-    if _DEVICE_POISONED:
+    if DEGRADE.state == _POISONED:
         return False
     from ..benchutil import probed_platform_cached
 
     platform = probed_platform_cached(timeout=90.0)  # probe timeout, not consensus  # upowlint: disable=CP001
     if platform is None:
-        _DEVICE_POISONED = True
+        DEGRADE.poison("jax backend init hung/failed")
         import logging
 
         logging.getLogger("upow_tpu.verify").warning(
@@ -180,17 +185,19 @@ def clear_sig_verdicts() -> None:
 
 
 def _resolve_backend(backend: str, n_checks: int) -> str:
-    """Apply the ``auto`` policy and the device-poison override (single
+    """Apply the ``auto`` policy and the device-health override (single
     source for the cached and uncached layers)."""
     if backend == "auto":
         if n_checks < 8:
             return "host"
-        return "device" if _device_usable() else "host"
-    if backend != "host" and _DEVICE_POISONED:
+        return "device" if (_device_usable() and DEGRADE.allow()) \
+            else "host"
+    if backend != "host" and not DEGRADE.allow():
         # an explicitly configured device backend must also honor the
-        # poison flag: re-paying device_timeout (and leaking another
+        # health state: re-paying device_timeout (and leaking another
         # stuck daemon thread) on every block would stall the node 4 min
-        # per block after one hang
+        # per block after one hang; a degraded device is only retried
+        # after its cooldown
         return "host"
     return backend
 
@@ -305,33 +312,39 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
         """Time-boxed device dispatch: a tunnel that dies AFTER the
         startup probe makes the call hang, not raise.  A hang poisons
         the device path immediately; raised exceptions are logged and
-        poison it after a few consecutive failures — either way the
-        caller re-runs on the host, and the node survives."""
-        global _DEVICE_POISONED, _DEVICE_FAILURES
+        degrade it (CPU fallback + cooldown re-probe) after a few
+        consecutive failures — either way the caller re-runs on the
+        host, and the node survives."""
         import logging
 
         from ..benchutil import boxed_call
+        from ..resilience.faultinject import get_injector
+
+        def dispatch():
+            # chaos hook: an injected hang lands INSIDE the boxed
+            # worker thread, exercising the same time-box a real stuck
+            # PJRT call would
+            injector = get_injector()
+            if injector is not None:
+                injector.fire_sync("device.verify")
+            return p256.verify_batch_prehashed(
+                digests, sigs, pubs, pad_block=pad_block,
+                mesh=_verify_mesh(mesh_devices))
 
         status, value = boxed_call(
-            lambda: p256.verify_batch_prehashed(
-                digests, sigs, pubs, pad_block=pad_block,
-                mesh=_verify_mesh(mesh_devices)),
+            dispatch,
             timeout=device_timeout)  # generous: covers first-call compile
         log = logging.getLogger("upow_tpu.verify")
         if status == "ok":
-            _DEVICE_FAILURES = 0
+            DEGRADE.record_success()
             return value
         if status == "err":
-            _DEVICE_FAILURES += 1
-            if _DEVICE_FAILURES >= _DEVICE_FAILURE_LIMIT:
-                _DEVICE_POISONED = True
+            DEGRADE.record_failure(value)
             log.warning(
-                "device verify dispatch failed (%d consecutive%s): %s",
-                _DEVICE_FAILURES,
-                "; device poisoned" if _DEVICE_POISONED else "",
-                value, exc_info=value)
+                "device verify dispatch failed (state=%s): %s",
+                DEGRADE.state, value, exc_info=value)
             raise value
-        _DEVICE_POISONED = True
+        DEGRADE.poison("device verify hung")
         log.warning(
             "device verify dispatch hung; falling back to host path "
             "(device poisoned for this process)")
@@ -345,6 +358,9 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
             [c[0] for c in checks], [c[2] for c in checks],
             [c[3] for c in checks])
     except Exception as e:
+        from .. import trace
+
+        trace.inc("resilience.device_fallback")
         log.warning("device verify pass-1 unusable (%s); host fallback for "
                     "%d checks", e, len(checks))
         return run_sig_checks(checks, backend="host", pad_block=pad_block,

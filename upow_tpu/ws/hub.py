@@ -68,6 +68,13 @@ class WsConnection:
 
     async def send(self, message: dict) -> bool:
         try:
+            from ..resilience.faultinject import get_injector
+
+            injector = get_injector()
+            if injector is not None:
+                # chaos hook: a hung/errored subscriber — the hub must
+                # reap it and keep broadcasting to everyone else
+                await injector.fire("ws.send", self.ip)
             payload = json.dumps(message)
             await self.ws.send_str(payload)
             self.messages_out += 1
@@ -218,7 +225,7 @@ class WsHub:
     async def _cleanup_loop(self) -> None:
         """Expire idle connections (reference socket_manager.py:333-352)."""
         while True:
-            await asyncio.sleep(60)
+            await asyncio.sleep(self.cfg.cleanup_interval)
             now = time.monotonic()
             for conn in list(self.connections.values()):
                 if now - conn.last_activity > self.cfg.connection_expiry:
