@@ -510,6 +510,74 @@ def config8_intake(seconds: float):
     _emit(f"push_tx_intake_{_platform()}", rate, "tx/s", base_rate)
 
 
+def config10_coalesced_intake(seconds: float):
+    """Concurrent push_tx through the coalescing mempool intake
+    (upow_tpu/mempool/intake.py): waves of simultaneous HTTP requests
+    share one signature dispatch per micro-batch instead of paying one
+    per tx — the continuous-batching win over config 8's serial
+    round-trips, measured on the same wire path."""
+    import tempfile
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from upow_tpu.config import Config
+    from upow_tpu.core import clock
+    from upow_tpu.node.app import Node
+
+    N_TX = 2048
+    WAVE = 64  # concurrent pushers per wave
+
+    async def scenario():
+        state, manager, d, pub, addr, mids, _mine = \
+            await _chain_with_utxo_fanout(10, 224, 0xC0A1)
+        txs = _leaf_spends(mids, addr, d, pub)
+        assert len(txs) >= N_TX
+        payloads = [t.hex() for t in txs[:N_TX]]
+
+        cfg = Config()
+        with tempfile.TemporaryDirectory() as tmp:
+            cfg.node.db_path = ""
+            cfg.node.seed_url = ""
+            cfg.node.peers_file = f"{tmp}/nodes.json"
+            cfg.node.ip_config_file = ""
+            cfg.log.path = ""
+            cfg.log.console = False
+            node = Node(cfg, state=state)
+            server = TestServer(node.app)
+            await server.start_server()
+            client = TestClient(server)
+            node.started = True
+            node.rate_limiter.enabled = False
+
+            async def push(p):
+                r = await (await client.post(
+                    "/push_tx", json={"tx_hex": p})).json()
+                assert r.get("ok"), r
+
+            try:
+                await push(payloads[0])  # warm, untimed
+                t0 = time.perf_counter()
+                done = 0
+                for i in range(1, len(payloads), WAVE):
+                    wave = payloads[i:i + WAVE]
+                    await asyncio.gather(*[push(p) for p in wave])
+                    done += len(wave)
+                    if time.perf_counter() - t0 > seconds:
+                        break
+                elapsed = time.perf_counter() - t0
+            finally:
+                await client.close()
+                await server.close()
+                await node.close()
+        return done / elapsed
+
+    base_rate = _python_verify_baseline()
+
+    rate = asyncio.run(scenario())
+    clock.reset()
+    _emit(f"push_tx_coalesced_{_platform()}", rate, "tx/s", base_rate)
+
+
 def config9_sync(seconds: float):
     """End-to-end chain sync over real localhost HTTP: node B downloads
     node A's chain in pages (prefetch pipeline, page-level signature
@@ -646,6 +714,7 @@ def main() -> int:
         "7": lambda: config7_txid_batch(args.seconds),
         "8": lambda: config8_intake(args.seconds),
         "9": lambda: config9_sync(args.seconds),
+        "10": lambda: config10_coalesced_intake(args.seconds),
     }
     needs_device = {"2", "3", "5", "7"}
     failed = []

@@ -313,3 +313,79 @@ def test_fault_schedules_are_reproducible():
 
     assert schedule(1337) == schedule(1337)
     assert schedule(1337) != schedule(7)
+
+
+# ------------------------------------------------------- mempool flood ----
+
+def test_mempool_flood_with_intake_faults(tmp_path, keys):
+    """Seeded flood through the coalescing intake while the
+    ``mempool.intake`` site misbehaves: the first two micro-batches are
+    rejected wholesale (as a verifier explosion would), later ones may
+    stall on injected latency.  Every concurrent pusher still gets a
+    wire-shaped answer — no hung futures — and the pool, the journal,
+    and the set of accepted responses all agree afterwards."""
+    async def scenario(cluster):
+        from upow_tpu.core.tx import Tx, TxInput, TxOutput
+
+        node, client = await cluster.add_node("a")
+        d, pub = curve.keygen(rng=4242)
+        addr = keys["addr"]
+        await mine_via_api(client, addr)
+        coin = (await node.state.get_spendable_outputs(addr))[0]
+        per = coin.amount // 16
+        outs = [TxOutput(addr, per)] * 15
+        outs.append(TxOutput(addr, coin.amount - per * 15))
+        fan = Tx([coin], outs).sign([d], lambda _i: pub)
+        res = await (await client.post(
+            "/push_tx", json={"tx_hex": fan.hex()})).json()
+        assert res["ok"], res
+        await mine_via_api(client, addr)
+
+        leaves = [Tx([TxInput(fan.hash(), k)],
+                     [TxOutput(addr, fan.outputs[k].amount)]).sign(
+                         [d], lambda _i: pub) for k in range(16)]
+
+        async def push(tx):
+            resp = await client.post("/push_tx", json={"tx_hex": tx.hex()})
+            return tx.hash(), await resp.json()
+
+        trace.reset()
+        node.config.mempool.coalesce_window_ms = 0.0  # drain eagerly
+        try:
+            faultinject.install(
+                "mempool.intake:error:times=2;"
+                "mempool.intake:latency:delay=0.01,p=0.5", seed=2024)
+            # two waves so the burst spans >= 2 micro-batches and both
+            # scheduled batch-errors actually fire
+            first = await asyncio.gather(*[push(t) for t in leaves[:8]])
+            await asyncio.sleep(0.05)
+            second = await asyncio.gather(*[push(t) for t in leaves[8:]])
+        finally:
+            faultinject.uninstall()
+
+        results = dict(first + second)
+        assert len(results) == 16
+        accepted = set()
+        for tx in leaves:
+            res = results[tx.hash()]
+            if res.get("ok"):
+                assert res["result"] == "Transaction has been accepted"
+                accepted.add(tx.hash())
+            else:
+                # batch-fault rejection keeps the serial wire shape
+                assert res["error"] == "Transaction has not been added"
+
+        counters = trace.counters()
+        assert counters["mempool.intake_faults"] == 2
+        assert counters["resilience.faults_injected"] >= 2
+        assert counters["mempool.intake_batches"] >= 2
+        assert counters["mempool.intake_txs"] == 16
+
+        # pool == journal == accepted responses: a faulted batch must
+        # not leave half-admitted txs anywhere
+        journal = {r["tx_hash"]
+                   for r in await node.state.load_pending_journal()}
+        assert {e.tx_hash for e in node.pool.ordered()} == journal
+        assert journal == accepted
+
+    run_cluster(tmp_path, scenario)

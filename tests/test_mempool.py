@@ -1,0 +1,429 @@
+"""Mempool subsystem tests (upow_tpu/mempool/).
+
+Differential against the reference semantics: the in-memory pool's
+ordering and capped slice are compared against the actual reference SQL
+(``ORDER BY CAST(fees AS REAL)/LENGTH(tx_hex) DESC, tx_hash`` with the
+break-at-first-overflow cap) run on a scratch sqlite; the template
+assembler against ``select_reference``; and the batched intake against
+the serial ``_verify_and_push_tx`` path over real localhost HTTP —
+every response must be byte-identical, with the 32-tx concurrent burst
+costing at most 4 signature dispatches (the acceptance criterion).
+
+Crash recovery: journal rows written before an abrupt stop rebuild the
+pool — contents, priority order, and the ``pending_spent_outputs``
+overlay — in a fresh process.
+"""
+
+import asyncio
+import hashlib
+import json
+import random
+import sqlite3
+import time
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from upow_tpu import trace
+from upow_tpu.core import clock, curve
+from upow_tpu.core.constants import MAX_BLOCK_SIZE_HEX
+from upow_tpu.core.header import BlockHeader
+from upow_tpu.core.merkle import merkle_root
+from upow_tpu.core.tx import Tx, TxInput, TxOutput
+from upow_tpu.mempool import (Mempool, MempoolEntry, TTLSet,
+                              assemble_template, select_reference)
+from upow_tpu.mine.engine import MiningJob, mine
+from upow_tpu.node.app import GENESIS_PREV_HASH, Node
+from upow_tpu.state.storage import ChainState
+from upow_tpu.verify import BlockManager, txverify
+
+from test_node import Cluster, make_config, mine_via_api, run_cluster  # noqa: F401 (fixtures)
+from test_node import easy_difficulty, keys  # noqa: F401
+
+
+# ------------------------------------------------------------- helpers ----
+
+def _synthetic(rng, fees=None, size=None, outpoints=()):
+    size = size if size is not None else rng.randrange(2, 400) * 2
+    tx_hex = "".join(rng.choice("0123456789abcdef") for _ in range(size))
+    return MempoolEntry(
+        tx_hash=hashlib.sha256(tx_hex.encode()).hexdigest(),
+        tx_hex=tx_hex,
+        fees=fees if fees is not None else rng.randrange(0, 10 ** 9),
+        outpoints=outpoints)
+
+
+async def _mine_block(state, manager, addr, txs):
+    clock.advance(60)
+    diff, last = await manager.calculate_difficulty()
+    prev = last["hash"] if last else GENESIS_PREV_HASH
+    header = BlockHeader(
+        previous_hash=prev, address=addr, merkle_root=merkle_root(txs),
+        timestamp=clock.timestamp(), difficulty_x10=int(diff * 10), nonce=0)
+    if last:
+        r = mine(MiningJob(header.prefix_bytes(), prev, diff),
+                 "python", batch=1 << 14, ttl=600)
+        header.nonce = r.nonce
+    errors = []
+    ok = await manager.create_block(header.hex(), txs, errors=errors)
+    assert ok, errors
+
+
+async def _funded_fanout(state, d, pub, addr, n):
+    """Two blocks: coinbase to ``addr``, then one fan tx splitting the
+    reward into ``n`` spendable outputs.  Returns the mined fan tx."""
+    manager = BlockManager(state)
+    pub_of = lambda _i: pub
+    await _mine_block(state, manager, addr, [])
+    coin = (await state.get_spendable_outputs(addr))[0]
+    per = coin.amount // n
+    outs = [TxOutput(addr, per)] * (n - 1)
+    outs.append(TxOutput(addr, coin.amount - per * (n - 1)))
+    fan = Tx([coin], outs).sign([d], pub_of)
+    await _mine_block(state, manager, addr, [fan])
+    return fan
+
+
+def _leaf(fan, k, addr, d, pub):
+    return Tx([TxInput(fan.hash(), k)],
+              [TxOutput(addr, fan.outputs[k].amount)]).sign(
+                  [d], lambda _i: pub)
+
+
+# ------------------------------------------------- pool differentials -----
+
+def test_pool_order_matches_reference_sql():
+    rng = random.Random(0xF00D)
+    con = sqlite3.connect(":memory:")
+    con.execute("CREATE TABLE pending_transactions"
+                " (tx_hash TEXT UNIQUE, tx_hex TEXT, fees TEXT)")
+    pool = Mempool()
+    entries = [_synthetic(rng) for _ in range(200)]
+    # forced fee-rate ties: the tx_hash tiebreak must match too
+    entries += [_synthetic(rng, fees=5000, size=100) for _ in range(8)]
+    for e in entries:
+        assert pool.add(e) == "added"
+        con.execute("INSERT INTO pending_transactions VALUES (?,?,?)",
+                    (e.tx_hash, e.tx_hex, str(e.fees)))
+    ref = [r[0] for r in con.execute(
+        "SELECT tx_hex FROM pending_transactions ORDER BY"
+        " CAST(fees AS REAL) / LENGTH(tx_hex) DESC, tx_hash")]
+    assert [e.tx_hex for e in pool.ordered()] == ref
+    # capped slice: the reference BREAKS at the first overflowing tx
+    for cap in (0, 137, 1000, 7919, 50_000, MAX_BLOCK_SIZE_HEX):
+        expect, total = [], 0
+        for tx_hex in ref:
+            if total + len(tx_hex) > cap:
+                break
+            total += len(tx_hex)
+            expect.append(tx_hex)
+        assert pool.select_hex(cap) == expect, cap
+    con.close()
+
+
+def test_pool_eviction_sheds_lowest_fee_rate():
+    rng = random.Random(42)
+    entries = [_synthetic(rng) for _ in range(50)]
+    cap = sum(e.size_hex for e in entries) // 2
+    pool = Mempool(max_bytes_hex=cap)
+    for e in entries:
+        pool.add(e)
+    ranked = pool.ordered()
+    # expected: walk the priority order from the tail until under cap
+    total = sum(e.size_hex for e in ranked)
+    expect = []
+    for e in reversed(ranked):
+        if total <= cap:
+            break
+        total -= e.size_hex
+        expect.append(e.tx_hash)
+    gen0 = pool.generation
+    assert pool.evict_over_cap() == expect
+    assert pool.total_bytes_hex <= cap
+    assert pool.generation > gen0
+    # survivors are exactly the high-priority prefix, still in order
+    assert [e.tx_hash for e in pool.ordered()] == \
+        [e.tx_hash for e in ranked[:len(ranked) - len(expect)]]
+
+
+def test_pool_ttl_expiry_uses_monotonic_age():
+    rng = random.Random(3)
+    pool = Mempool(tx_ttl=100.0)
+    fresh = _synthetic(rng)
+    stale = _synthetic(rng)
+    stale.added_mono = fresh.added_mono - 1000.0
+    pool.add(fresh)
+    pool.add(stale)
+    assert pool.expire(now_mono=fresh.added_mono + 1.0) == [stale.tx_hash]
+    assert stale.tx_hash not in pool and fresh.tx_hash in pool
+
+
+def test_pool_conflict_and_rbf():
+    rng = random.Random(9)
+    op = ("ab" * 32, 0)
+    low = _synthetic(rng, fees=10, size=100, outpoints=(op,))
+    high = _synthetic(rng, fees=10 ** 6, size=100, outpoints=(op,))
+    pool = Mempool()
+    assert pool.add(low) == "added"
+    assert pool.add(low) == "duplicate"
+    # default (intake) policy: first writer wins, conflicts rejected
+    assert pool.add(high) == "conflict"
+    assert pool.spender_of(op) == low.tx_hash
+    # opt-in RBF: strictly higher fee rate evicts the holder
+    rbf = Mempool(allow_rbf=True)
+    rbf.add(low)
+    assert rbf.add(high) == "replaced"
+    assert rbf.spender_of(op) == high.tx_hash
+    assert low.tx_hash not in rbf
+    # equal fee rate never replaces
+    assert rbf.add(_synthetic(rng, fees=10 ** 6, size=100,
+                              outpoints=(op,))) == "conflict"
+
+
+# ------------------------------------------------------ template packing --
+
+def test_template_equals_reference_without_dependencies():
+    rng = random.Random(0xBEEF)
+    pool = Mempool()
+    for _ in range(60):
+        pool.add(_synthetic(rng))
+    ranked = pool.ordered()
+    for cap in (0, 500, 4000, 40_000, MAX_BLOCK_SIZE_HEX):
+        assert assemble_template(ranked, cap) == \
+            select_reference(ranked, cap), cap
+
+
+def test_template_packs_parent_before_child():
+    parent = MempoolEntry(tx_hash="aa" * 32, tx_hex="0" * 100, fees=1)
+    child = MempoolEntry(tx_hash="bb" * 32, tx_hex="1" * 100, fees=90,
+                         outpoints=(("aa" * 32, 0),))
+    other = MempoolEntry(tx_hash="cc" * 32, tx_hex="2" * 100, fees=50)
+    ranked = sorted([parent, child, other], key=lambda e: e.sort_key)
+    assert [e.tx_hash for e in ranked] == \
+        [child.tx_hash, other.tx_hash, parent.tx_hash]
+    packed = assemble_template(ranked, 10_000)
+    # child deferred until its in-pool parent lands
+    assert [e.tx_hash for e in packed] == \
+        [other.tx_hash, parent.tx_hash, child.tx_hash]
+    # parent misses the cap -> child is dropped, not packed unspendable
+    packed = assemble_template(ranked, 150)
+    assert [e.tx_hash for e in packed] == [other.tx_hash]
+    # a parent already confirmed on-chain (not in the pool) is no dep
+    orphanless = MempoolEntry(tx_hash="dd" * 32, tx_hex="3" * 100, fees=90,
+                              outpoints=(("ee" * 32, 1),))
+    assert assemble_template([orphanless], 10_000) == [orphanless]
+
+
+# ------------------------------------------------------------- TTL set ----
+
+def test_ttlset_capacity_and_ttl():
+    s = TTLSet(maxlen=3, ttl=600.0)
+    for key in ("a", "b", "c"):
+        s.add(key)
+    assert len(s) == 3 and "a" in s
+    s.append("d")  # deque-compatible alias; evicts the oldest
+    assert "a" not in s and all(k in s for k in ("b", "c", "d"))
+    # re-add refreshes recency: "b" survives the next eviction
+    s.add("b")
+    s.add("e")
+    assert "c" not in s and "b" in s
+    # age expiry
+    fast = TTLSet(maxlen=10, ttl=0.01)
+    fast.add("x")
+    assert "x" in fast
+    time.sleep(0.03)
+    assert "x" not in fast and len(fast) == 0
+    # ttl=0 disables expiry
+    forever = TTLSet(maxlen=10, ttl=0.0)
+    forever.add("y")
+    assert "y" in forever
+
+
+def test_trace_histograms_fixed_buckets():
+    trace.reset()
+    try:
+        trace.observe("t.size", 1, buckets=(1, 4, 16))
+        trace.observe("t.size", 3, buckets=(99,))  # ignored: bounds fixed
+        trace.observe("t.size", 100)
+        h = trace.histograms()["t.size"]
+        assert h["bounds"] == (1, 4, 16)
+        assert h["counts"] == [1, 1, 0, 1]  # +Inf overflow last
+        assert h["count"] == 3 and h["sum"] == 104
+    finally:
+        trace.reset()
+
+
+# ------------------------------------------------------ journal recovery --
+
+def test_journal_rebuilds_pool_after_crash(tmp_path, keys):
+    async def main():
+        path = str(tmp_path / "crash.db")
+        state = ChainState(path)
+        d, pub = curve.keygen(rng=4242)
+        addr = keys["addr"]
+        fan = await _funded_fanout(state, d, pub, addr, 4)
+        leaves = [_leaf(fan, k, addr, d, pub) for k in range(4)]
+        for tx in leaves:
+            await state.add_pending_transaction(tx)
+        # abrupt stop: no pool shutdown, no mempool GC — only the
+        # write-through journal survives
+        state.close()
+
+        state2 = ChainState(path)
+        pool = Mempool()
+        assert await pool.sync(state2) is True
+        assert {e.tx_hash for e in pool.ordered()} == \
+            {tx.hash() for tx in leaves}
+        # conflict map rebuilt == the pending_spent_outputs overlay
+        assert set(pool._spends) == \
+            await state2.get_pending_spent_outpoints()
+        # recovered priority slice equals the reference SQL's
+        assert pool.select_hex(MAX_BLOCK_SIZE_HEX) == \
+            await state2.get_pending_transactions_limit(hex_only=True)
+        # second sync with an unchanged journal is a cheap no-op
+        assert await pool.sync(state2) is False
+        state2.close()
+
+    asyncio.run(main())
+
+
+def test_reorg_reinjects_rolled_back_txs(tmp_path, keys):
+    async def main():
+        state = ChainState()
+        state.reinject_reorg_txs = True
+        manager = BlockManager(state)
+        d, pub = curve.keygen(rng=4242)
+        addr = keys["addr"]
+        fan = await _funded_fanout(state, d, pub, addr, 3)
+        parent = _leaf(fan, 0, addr, d, pub)
+        await _mine_block(state, manager, addr, [parent])       # block 3
+        child = Tx([TxInput(parent.hash(), 0)],
+                   [TxOutput(addr, parent.outputs[0].amount)]).sign(
+                       [d], lambda _i: pub)
+        await _mine_block(state, manager, addr, [child])        # block 4
+
+        await state.remove_blocks(3)
+        journal = {r["tx_hash"] for r in await state.load_pending_journal()}
+        # parent spends a still-confirmed output -> re-injected;
+        # child spends an output the rollback destroyed -> dropped;
+        # coinbases never re-enter the mempool
+        assert parent.hash() in journal
+        assert child.hash() not in journal
+        assert len(journal) == 1
+        assert (fan.hash(), 0) in await state.get_pending_spent_outpoints()
+        # a pool syncs the re-injected tx straight back in
+        pool = Mempool()
+        await pool.sync(state)
+        assert parent.hash() in pool
+        state.close()
+
+    asyncio.run(main())
+
+
+def test_reorg_reinjection_off_by_default(tmp_path, keys):
+    async def main():
+        state = ChainState()
+        manager = BlockManager(state)
+        d, pub = curve.keygen(rng=4242)
+        addr = keys["addr"]
+        fan = await _funded_fanout(state, d, pub, addr, 3)
+        await _mine_block(state, manager, addr,
+                          [_leaf(fan, 0, addr, d, pub)])
+        await state.remove_blocks(3)
+        assert await state.load_pending_journal() == []
+        state.close()
+
+    asyncio.run(main())
+
+
+# ------------------------------------- intake: dispatch count + parity ----
+
+def test_intake_dispatch_count_and_serial_parity(tmp_path, keys, monkeypatch):
+    """The acceptance criterion: 32 concurrently pushed txs complete
+    with <= 4 P-256 batch dispatches, and every response (accepted,
+    duplicate, and invalid) is byte-identical to the serial path's."""
+
+    async def scenario(cluster):
+        node_a, client_a = await cluster.add_node("a")
+        # serial baseline node: identical config, mempool subsystem off
+        cfg = make_config(tmp_path, "b")
+        cfg.mempool.enabled = False
+        node_b = Node(cfg)
+        server_b = TestServer(node_b.app)
+        await server_b.start_server()
+        client_b = TestClient(server_b)
+        node_b.self_url = f"http://127.0.0.1:{server_b.port}"
+        node_b.started = True
+        cluster.nodes.append(node_b)
+        cluster.servers.append(server_b)
+        cluster.clients.append(client_b)
+
+        d, pub = curve.keygen(rng=4242)
+        addr = keys["addr"]
+        await mine_via_api(client_a, addr)
+        coin = (await node_a.state.get_spendable_outputs(addr))[0]
+        per = coin.amount // 33
+        outs = [TxOutput(addr, per)] * 32
+        outs.append(TxOutput(addr, coin.amount - per * 32))
+        fan = Tx([coin], outs).sign([d], lambda _i: pub)
+        res = await (await client_a.post(
+            "/push_tx", json={"tx_hex": fan.hex()})).json()
+        assert res["ok"], res
+        await mine_via_api(client_a, addr)
+        # replay the identical chain onto the serial node
+        res = await (await client_b.get(
+            "/sync_blockchain", params={"node_url": cluster.url(0)})).json()
+        assert res["ok"], res
+        assert (await node_b.state.get_next_block_id()
+                == await node_a.state.get_next_block_id())
+
+        leaves = [_leaf(fan, k, addr, d, pub) for k in range(32)]
+        # a spend of the already-consumed coinbase: invalid on both
+        invalid = Tx([coin], [TxOutput(addr, coin.amount)]).sign(
+            [d], lambda _i: pub)
+        # 32 valid + 1 in-flight duplicate + 1 invalid, all concurrent
+        burst = leaves + [leaves[0], invalid]
+
+        calls = []
+        real = txverify.run_sig_checks_async
+
+        async def counting(checks, **kw):
+            calls.append(len(checks))
+            return await real(checks, **kw)
+
+        monkeypatch.setattr(txverify, "run_sig_checks_async", counting)
+        # widen the window so the whole burst coalesces predictably
+        node_a.config.mempool.coalesce_window_ms = 25.0
+
+        async def push(client, tx):
+            resp = await client.post("/push_tx", json={"tx_hex": tx.hex()})
+            return tx.hash(), resp.status, await resp.read()
+
+        a_results = await asyncio.gather(*[push(client_a, t) for t in burst])
+        n_dispatches = len(calls)
+        assert n_dispatches <= 4, (n_dispatches, calls)
+        assert sum(json.loads(body)["ok"]
+                   for _, _, body in a_results) == 32
+
+        b_results = [await push(client_b, t) for t in burst]
+
+        def by_hash(results):
+            grouped = {}
+            for tx_hash, status, body in results:
+                grouped.setdefault(tx_hash, []).append((status, body))
+            return {h: sorted(v) for h, v in grouped.items()}
+
+        assert by_hash(a_results) == by_hash(b_results)
+
+        # post-burst duplicates (dedup-cache hits) match bytewise too
+        for probe in (leaves[3], invalid):
+            _, sa, ba = await push(client_a, probe)
+            _, sb, bb = await push(client_b, probe)
+            assert (sa, ba) == (sb, bb)
+
+        # journal and pool agree after the burst
+        journal = {r["tx_hash"]
+                   for r in await node_a.state.load_pending_journal()}
+        assert {e.tx_hash for e in node_a.pool.ordered()} == journal
+        assert len(journal) == 32
+
+    run_cluster(tmp_path, scenario)

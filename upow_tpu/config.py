@@ -113,6 +113,38 @@ class ResilienceConfig:
 
 
 @dataclass
+class MempoolConfig:
+    """Micro-batched mempool subsystem (upow_tpu/mempool/).
+
+    All operational policy: nodes with different mempool settings stay
+    bit-identical on chain state, and push_tx keeps the reference wire
+    shape (error strings / status codes) regardless of these knobs.
+    """
+
+    enabled: bool = True            # False = per-request serial intake
+                                    # (the reference-shaped path, kept
+                                    # as the differential baseline)
+    coalesce_window_ms: float = 2.0  # admission-queue drain window: how
+                                    # long the first waiter of a batch
+                                    # holds the door for stragglers
+    max_intake_batch: int = 128     # txs per micro-batch (one P-256
+                                    # device dispatch per batch)
+    max_pool_bytes_hex: int = 64 * 1024 * 1024  # pool byte cap (hex
+                                    # chars, 16 reference blocks);
+                                    # overflow evicts lowest fee-rate
+    tx_ttl: float = 7200.0          # seconds before an un-mined pooled
+                                    # tx expires (0 = never)
+    tx_cache_size: int = 1 << 16    # push_tx dedup set capacity
+                                    # (replaces the 100-entry deque)
+    tx_cache_ttl: float = 600.0     # seconds a dedup entry stays live
+    allow_rbf: bool = False         # replace-by-fee on outpoint
+                                    # conflict (pool API only; intake
+                                    # keeps the reference reject)
+    reinject_on_reorg: bool = True  # re-queue txs from rolled-back
+                                    # blocks into the journal/pool
+
+
+@dataclass
 class NodeConfig:
     host: str = "0.0.0.0"
     port: int = 3006                # reference run_node.py port
@@ -190,6 +222,7 @@ class Config:
     miner: MinerConfig = field(default_factory=MinerConfig)
     log: LogConfig = field(default_factory=LogConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
 
     @classmethod
     def load(cls, path: Optional[str] = None, **overrides) -> "Config":
@@ -229,7 +262,8 @@ def _merge_dict(cfg: Config, data: dict) -> Config:
 
 
 def _merge_env(cfg: Config) -> Config:
-    for section in ("device", "node", "ws", "miner", "log", "resilience"):
+    for section in ("device", "node", "ws", "miner", "log", "resilience",
+                    "mempool"):
         sub = getattr(cfg, section)
         for f in dataclasses.fields(sub):
             env = f"UPOW_{section.upper()}_{f.name.upper()}"

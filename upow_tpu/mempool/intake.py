@@ -1,0 +1,273 @@
+"""Coalesced transaction admission: micro-batched push_tx intake.
+
+The continuous-batching idea from inference serving applied to tx
+intake: concurrent ``push_tx`` requests are queued, drained in
+micro-batches (``coalesce_window_ms`` / ``max_intake_batch``), each tx
+runs its host-side rule checks individually, and every surviving
+``SigCheck`` across the whole batch goes to P-256 verification in ONE
+``run_sig_checks_async`` dispatch — N concurrent requests cost ≪ N
+device round-trips.  The degrade manager still decides the batch's
+backend (``_resolve_backend`` inside run_sig_checks consults DEGRADE),
+so a benched TPU transparently serves the batch on the host path.
+
+Wire compatibility is the hard constraint: every waiter resolves with
+a result dict byte-identical to the serial ``_verify_and_push_tx``
+path — same strings, same order of precedence between rejection
+reasons (coinbase/unsigned, dedup cache, banned address, already
+pending, rule/signature failure).  The acceptance test in
+tests/test_mempool.py pins this differentially against a serial node.
+
+Fault injection: the ``mempool.intake`` site fires once per batch
+before the signature dispatch — ``latency`` stalls the batch,
+``error`` rejects it the same way a verifier exception would
+(the serial path's behaviour for an exploding verify).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional
+
+from .. import trace
+from ..logger import get_logger
+from ..resilience.faultinject import FaultInjected, get_injector
+from ..verify import txverify
+from .pool import MempoolEntry
+
+log = get_logger("mempool")
+
+# push_tx wire strings — must stay byte-identical to the reference
+# (and to the serial path in node/app.py)
+ERR_NOT_ADDED = "Transaction has not been added"
+ERR_JUST_ADDED = "Transaction just added"
+ERR_FORBIDDEN = "Access forbidden temporarily."
+ERR_PRESENT = "Transaction already present"
+MSG_ACCEPTED = "Transaction has been accepted"
+
+_BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+def _reject(error: str) -> dict:
+    return {"ok": False, "error": error}
+
+
+class _Req:
+    __slots__ = ("tx", "sender", "fut", "t0", "tx_hash", "first_address",
+                 "checks", "slice", "dup_of", "result")
+
+    def __init__(self, tx, sender, fut):
+        self.tx = tx
+        self.sender = sender
+        self.fut = fut
+        self.t0 = time.perf_counter()
+        self.tx_hash: Optional[str] = None
+        self.first_address: Optional[str] = None
+        self.checks: Optional[list] = None
+        self.slice = (0, 0)
+        self.dup_of: Optional["_Req"] = None
+        self.result: Optional[dict] = None
+
+
+class IntakeCoordinator:
+    """Admission queue + drainer for one node.
+
+    ``node`` is the owning Node instance (duck-typed: state, pool,
+    tx_cache, config, make_tx_verifier(), accept_tx_effects(),
+    _background).  The drainer task is lazily started by the first
+    submit and re-registered with the node's background-task set so
+    Node.close() reaps it.
+    """
+
+    def __init__(self, node, banned_addresses=frozenset()):
+        self.node = node
+        self.banned = banned_addresses
+        self._queue: List[_Req] = []
+        self._drainer: Optional[asyncio.Task] = None
+
+    # ------------------------------------------------------------ entry ---
+
+    async def submit(self, tx, sender: Optional[str]) -> dict:
+        """Queue one tx and wait for its wire-compatible result dict."""
+        fut = asyncio.get_event_loop().create_future()
+        self._queue.append(_Req(tx, sender, fut))
+        self._ensure_drainer()
+        return await fut
+
+    def _ensure_drainer(self) -> None:
+        if self._drainer is not None and not self._drainer.done():
+            return
+        self._drainer = asyncio.ensure_future(self._drain())
+        bg = getattr(self.node, "_background", None)
+        if bg is not None:
+            bg.add(self._drainer)
+            self._drainer.add_done_callback(bg.discard)
+
+    async def _drain(self) -> None:
+        try:
+            while self._queue:
+                window = self.node.config.mempool.coalesce_window_ms / 1000.0
+                if window > 0:
+                    # hold the door: stragglers arriving inside the
+                    # window join this batch instead of paying their
+                    # own dispatch
+                    await asyncio.sleep(window)
+                batch = self._queue[:self.node.config.mempool.max_intake_batch]
+                del self._queue[:len(batch)]
+                if batch:
+                    await self._process(batch)
+        except asyncio.CancelledError:
+            # node shutdown: nothing may hang on an unresolved future
+            for req in self._queue:
+                self._resolve(req, _reject(ERR_NOT_ADDED))
+            self._queue.clear()
+            raise
+
+    def _resolve(self, req: _Req, result: dict) -> None:
+        req.result = result
+        if not req.fut.done():
+            req.fut.set_result(result)
+        trace.observe("mempool.admit_latency",
+                      time.perf_counter() - req.t0)
+
+    # ------------------------------------------------------------ batch ---
+
+    async def _process(self, batch: List[_Req]) -> None:
+        try:
+            with trace.span("mempool.intake_batch", n=len(batch)):
+                await self._process_inner(batch)
+        except Exception as e:  # no waiter may hang; mirror the serial
+            # path's catch-all around verify (reject, don't 500)
+            log.error("intake batch failed: %s", e, exc_info=True)
+            for req in batch:
+                if not req.fut.done():
+                    self._resolve(req, _reject(ERR_NOT_ADDED))
+
+    async def _process_inner(self, batch: List[_Req]) -> None:
+        node = self.node
+        trace.inc("mempool.intake_batches")
+        trace.inc("mempool.intake_txs", len(batch))
+        trace.observe("mempool.intake_batch_size", len(batch),
+                      buckets=_BATCH_SIZE_BUCKETS)
+
+        inj = get_injector()
+        if inj is not None:
+            try:
+                await inj.fire("mempool.intake", key=str(len(batch)))
+            except FaultInjected:
+                trace.inc("mempool.intake_faults")
+                for req in batch:
+                    self._resolve(req, _reject(ERR_NOT_ADDED))
+                return
+
+        # pull in external journal writers (wallet CLI, block accept)
+        # before membership checks — the pool is the intake authority
+        await node.pool.sync(node.state)
+
+        # -- phase A: per-tx host-side checks, batch order -----------------
+        seen: Dict[str, _Req] = {}
+        survivors: List[_Req] = []
+        for req in batch:
+            tx = req.tx
+            if getattr(tx, "is_coinbase", False) or any(
+                    i.signature is None for i in tx.inputs):
+                self._resolve(req, _reject(ERR_NOT_ADDED))
+                continue
+            req.tx_hash = tx.hash()
+            first = seen.get(req.tx_hash)
+            if first is not None:
+                req.dup_of = first  # settled after the first instance
+                continue
+            seen[req.tx_hash] = req
+            if req.tx_hash in node.tx_cache:
+                self._resolve(req, _reject(ERR_JUST_ADDED))
+                continue
+            if tx.inputs:
+                req.first_address = await node.state.resolve_output_address(
+                    tx.inputs[0].tx_hash, tx.inputs[0].index)
+            if req.first_address in self.banned:
+                self._resolve(req, _reject(ERR_FORBIDDEN))
+                continue
+            if req.tx_hash in node.pool:
+                self._resolve(req, _reject(ERR_PRESENT))
+                continue
+            try:
+                checks = await node.make_tx_verifier().prepare_pending(tx)
+            except Exception as e:  # serial parity: verify errors reject
+                log.info("tx verify error %s: %s", req.tx_hash, e)
+                checks = None
+            if checks is None:
+                self._resolve(req, _reject(ERR_NOT_ADDED))
+                continue
+            req.checks = checks
+            survivors.append(req)
+
+        # -- phase B: ONE signature dispatch for the whole batch -----------
+        flat: list = []
+        for req in survivors:
+            req.slice = (len(flat), len(flat) + len(req.checks))
+            flat.extend(req.checks)
+        verdicts: List[bool] = []
+        if flat:
+            dev = node.config.device
+            try:
+                with trace.span("mempool.sig_dispatch", n=len(flat)):
+                    verdicts = await txverify.run_sig_checks_async(
+                        flat, backend=dev.sig_backend,
+                        pad_block=dev.verify_pad_block,
+                        device_timeout=dev.verify_device_timeout,
+                        mesh_devices=dev.mesh_devices)
+            except Exception as e:  # serial parity: verify errors reject
+                log.warning("intake signature dispatch failed: %s", e)
+                for req in survivors:
+                    self._resolve(req, _reject(ERR_NOT_ADDED))
+                survivors = []
+
+        # -- phase C: finalize in batch order ------------------------------
+        claimed: Dict[tuple, str] = {}  # intra-batch outpoint claims
+        for req in survivors:
+            lo, hi = req.slice
+            if not all(verdicts[lo:hi]):
+                self._resolve(req, _reject(ERR_NOT_ADDED))
+                continue
+            outpoints = tuple(i.outpoint for i in req.tx.inputs)
+            if any(op in claimed for op in outpoints):
+                # an earlier tx of this batch claimed the outpoint —
+                # exactly the serial path's pending-double-spend reject
+                self._resolve(req, _reject(ERR_NOT_ADDED))
+                continue
+            try:
+                await node.state.add_pending_transaction(req.tx)
+            except Exception as e:  # serial parity (journal reject)
+                log.info("tx rejected %s: %s", req.tx_hash, e)
+                self._resolve(req, _reject(ERR_NOT_ADDED))
+                continue
+            for op in outpoints:
+                claimed[op] = req.tx_hash
+            node.pool.add(MempoolEntry(
+                tx_hash=req.tx_hash, tx_hex=req.tx.hex(),
+                fees=await node.state.tx_fees(req.tx),
+                outpoints=outpoints, tx=req.tx))
+            await node.accept_tx_effects(req.tx, req.tx_hash,
+                                         req.first_address, req.sender)
+            self._resolve(req, {"ok": True, "result": MSG_ACCEPTED,
+                                "tx_hash": req.tx_hash})
+
+        # duplicates: the first instance's fate decides (serial parity:
+        # an accepted first instance is in the dedup cache by the time
+        # the second would run; a rejected one re-fails the same way)
+        for req in batch:
+            if req.dup_of is None or req.fut.done():
+                continue
+            first_result = req.dup_of.result or _reject(ERR_NOT_ADDED)
+            if first_result.get("ok"):
+                self._resolve(req, _reject(ERR_JUST_ADDED))
+            else:
+                self._resolve(req, dict(first_result))
+
+        # the pool already contains this batch's writes — record the
+        # journal stamp so the next sync() is a no-op, then apply the
+        # byte cap and TTL (write-through: evictions leave the journal)
+        node.pool.mark_journal_stamp(
+            await node.state.pending_journal_stamp())
+        await node.pool.enforce_limits(node.state)

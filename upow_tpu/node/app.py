@@ -28,13 +28,15 @@ from aiohttp import web
 
 from .. import trace
 from ..config import Config
-from ..core.constants import ENDIAN, MAX_SUPPLY, SMALLEST, VERSION
+from ..core.constants import (ENDIAN, MAX_BLOCK_SIZE_HEX, MAX_SUPPLY,
+                              SMALLEST, VERSION)
 from ..core.clock import timestamp
 from ..core.rewards import get_circulating_supply
 from ..core.header import block_to_bytes, split_block_content
 from ..core.merkle import merkle_root
 from ..core.tx import AmbiguousSignatureError, CoinbaseTx, Tx, tx_from_hex
 from ..logger import get_logger, setup_logging
+from ..mempool import IntakeCoordinator, Mempool, MiningInfoCache, TTLSet
 from ..resilience import (BreakerRegistry, ResilienceContext, faultinject)
 from ..state.storage import ChainState
 from ..verify.block import BlockManager
@@ -140,8 +142,22 @@ class Node:
         self.is_syncing = False
         self.started = False
         self.self_url = self.config.node.self_url
-        self.tx_cache: deque = deque(maxlen=100)
-        self._last_mempool_clean = 0
+        # micro-batched mempool subsystem (docs/MEMPOOL.md): in-memory
+        # fee-priority pool is the read authority, the SQL
+        # pending_transactions table is demoted to write-behind journal
+        mcfg = self.config.mempool
+        self.pool = Mempool(max_bytes_hex=mcfg.max_pool_bytes_hex,
+                            tx_ttl=mcfg.tx_ttl, allow_rbf=mcfg.allow_rbf)
+        self.intake = IntakeCoordinator(self, _BANNED_ADDRESSES)
+        self.mining_cache = MiningInfoCache()
+        self.state.reinject_reorg_txs = bool(mcfg.enabled
+                                             and mcfg.reinject_on_reorg)
+        # push_tx dedup: config-sized TTL set — the reference's 100-entry
+        # deque cycles out in milliseconds at target intake rates,
+        # reopening the duplicate-propagation window it exists to close
+        self.tx_cache = (TTLSet(mcfg.tx_cache_size, mcfg.tx_cache_ttl)
+                         if mcfg.enabled else deque(maxlen=100))
+        self._last_mempool_clean: Optional[float] = None  # monotonic
         self._closing = False
         self._background: set = set()
         self._http_session = None  # shared gossip/RPC session, lazy
@@ -368,6 +384,45 @@ class Node:
             log.debug("bootstrap failed: %s", e)
 
     # ------------------------------------------------------- tx intake ----
+    def make_tx_verifier(self) -> TxVerifier:
+        """One verifier wired with this node's device knobs (shared by
+        the serial path and the batched intake)."""
+        return TxVerifier(
+            self.state,
+            verify_pad_block=self.config.device.verify_pad_block,
+            verify_device_timeout=self.config.device.verify_device_timeout,
+            verify_mesh_devices=self.config.device.mesh_devices)
+
+    async def accept_tx_effects(self, tx: Tx, tx_hash: str,
+                                first_address: Optional[str],
+                                sender: Optional[str]) -> None:
+        """Post-acceptance side effects, shared by the serial path and
+        the batched intake: peer bookkeeping, gossip fan-out, WS
+        broadcast, dedup cache, log line."""
+        if sender:
+            self.peers.update_last_message(sender)
+        self._spawn(self.propagate("push_tx", {"tx_hex": tx.hex()}))
+        if self.ws_hub is not None:
+            amount = sum(o.amount for o in tx.outputs)
+            self._spawn(self.ws_hub.broadcast_new_transaction({
+                "tx_hash": tx_hash,
+                "from": first_address,
+                "to": [o.address for o in tx.outputs],
+                "amount": _fmt_amount(amount),
+                "fees": _fmt_amount(await self.state.tx_fees(tx)),
+            }))
+        self.tx_cache.append(tx_hash)
+        log.info("Transaction has been accepted: %s", tx_hash)
+
+    async def _submit_tx(self, tx: Tx, sender: Optional[str]) -> dict:
+        """Route one tx into admission: the coalescing intake when the
+        mempool subsystem is on (this request joins the current
+        micro-batch and shares its signature dispatch), else the serial
+        reference path."""
+        if self.config.mempool.enabled:
+            return await self.intake.submit(tx, sender)
+        return await self._verify_and_push_tx(tx, sender)
+
     async def _verify_and_push_tx(self, tx: Tx,
                                   sender: Optional[str]) -> dict:
         # a coinbase is only ever built by block acceptance — a pushed one
@@ -395,13 +450,8 @@ class Node:
         # Without this, any parseable garbage enters the mempool and gets
         # handed to miners, whose blocks then fail acceptance.
         try:
-            ok = await TxVerifier(
-                self.state,
-                verify_pad_block=self.config.device.verify_pad_block,
-                verify_device_timeout=(
-                    self.config.device.verify_device_timeout),
-                verify_mesh_devices=self.config.device.mesh_devices,
-            ).verify_pending(tx, sig_backend=self.config.device.sig_backend)
+            ok = await self.make_tx_verifier().verify_pending(
+                tx, sig_backend=self.config.device.sig_backend)
         except Exception as e:
             log.info("tx verify error %s: %s", tx_hash, e)
             ok = False
@@ -412,20 +462,7 @@ class Node:
         except Exception as e:
             log.info("tx rejected %s: %s", tx_hash, e)
             return {"ok": False, "error": "Transaction has not been added"}
-        if sender:
-            self.peers.update_last_message(sender)
-        self._spawn(self.propagate("push_tx", {"tx_hex": tx.hex()}))
-        if self.ws_hub is not None:
-            amount = sum(o.amount for o in tx.outputs)
-            self._spawn(self.ws_hub.broadcast_new_transaction({
-                "tx_hash": tx_hash,
-                "from": first_address,
-                "to": [o.address for o in tx.outputs],
-                "amount": _fmt_amount(amount),
-                "fees": _fmt_amount(await self.state.tx_fees(tx)),
-            }))
-        self.tx_cache.append(tx_hash)
-        log.info("Transaction has been accepted: %s", tx_hash)
+        await self.accept_tx_effects(tx, tx_hash, first_address, sender)
         return {"ok": True, "result": "Transaction has been accepted",
                 "tx_hash": tx_hash}
 
@@ -433,20 +470,43 @@ class Node:
     async def _mining_info_result(self) -> dict:
         self.manager.invalidate_difficulty()
         difficulty, last_block = await self.manager.get_difficulty()
-        pending = sorted(await self.state.get_pending_transactions_limit(
-            hex_only=True))
-        if self._last_mempool_clean < timestamp() - self.config.node.mempool_clean_interval:
-            self._last_mempool_clean = timestamp()
+        # mempool-GC timer on the MONOTONIC clock: the consensus
+        # timestamp() the reference keys this off tracks the wall
+        # clock, so an NTP step either fires a clear per poll or
+        # suppresses clears entirely
+        now = time.monotonic()
+        if (self._last_mempool_clean is None
+                or now - self._last_mempool_clean
+                > self.config.node.mempool_clean_interval):
+            self._last_mempool_clean = now
             self._spawn(self.manager.clear_pending_transactions())
-        return {
+        last_json = _json_block(last_block)
+        key = None
+        if self.config.mempool.enabled:
+            await self.pool.sync(self.state)
+            key = (self.pool.generation, (last_json or {}).get("hash"),
+                   float(difficulty))
+            cached = self.mining_cache.get(key)
+            if cached is not None:
+                return cached
+            # the pool slice IS the reference query (pool.py docstring);
+            # no SQL on the miner polling hot path
+            pending = sorted(self.pool.select_hex(MAX_BLOCK_SIZE_HEX))
+        else:
+            pending = sorted(await self.state.get_pending_transactions_limit(
+                hex_only=True))
+        result = {
             "difficulty": float(difficulty),
-            "last_block": _json_block(last_block),
+            "last_block": last_json,
             "pending_transactions": pending[:10],
             "pending_transactions_hashes": [
                 hashlib.sha256(bytes.fromhex(t)).hexdigest() for t in pending],
             "merkle_root": merkle_root(
                 [tx_from_hex(t, check_signatures=False) for t in pending[:10]]),
         }
+        if key is not None:
+            self.mining_cache.put(key, result)
+        return result
 
     # --------------------------------------------------------- handlers ---
     async def h_root(self, request: web.Request) -> web.Response:
@@ -480,6 +540,20 @@ class Node:
         gauge("upow_mempool_transactions",
               await self.state.get_pending_transactions_count(),
               "Transactions waiting in the mempool")
+        if self.config.mempool.enabled:
+            gauge("upow_mempool_pool_depth", len(self.pool),
+                  "Transactions in the in-memory fee-priority pool")
+            gauge("upow_mempool_pool_bytes_hex", self.pool.total_bytes_hex,
+                  "Total hex chars held by the in-memory pool")
+            for key, help_text in (
+                    ("hits", "Mining-info requests served from the"
+                             " generation-keyed cache"),
+                    ("misses", "Mining-info requests that rebuilt the"
+                               " template")):
+                name = f"upow_mining_info_cache_{key}_total"
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {getattr(self.mining_cache, key)}")
         gauge("upow_peers_known", len(self.peers.all_nodes()),
               "Peers in the peer book")
         gauge("upow_peers_active", len(self.peers.recent_nodes()),
@@ -522,6 +596,17 @@ class Node:
             lines.append(f"upow_span_{safe}_seconds_total {s['total_s']:.6f}")
             lines.append(f"# TYPE upow_span_{safe}_seconds_max gauge")
             lines.append(f"upow_span_{safe}_seconds_max {s['max_s']:.6f}")
+        for name, h in sorted(trace.histograms().items()):
+            safe = name.replace(".", "_").replace("-", "_")
+            lines.append(f"# TYPE upow_{safe} histogram")
+            cum = 0
+            for bound, count in zip(h["bounds"], h["counts"]):
+                cum += count
+                lines.append(f'upow_{safe}_bucket{{le="{bound}"}} {cum}')
+            cum += h["counts"][-1]
+            lines.append(f'upow_{safe}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"upow_{safe}_sum {h['sum']:.6f}")
+            lines.append(f"upow_{safe}_count {h['count']}")
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 
@@ -540,7 +625,7 @@ class Node:
             log.debug("push_tx: rejecting unparseable tx: %s", e)
             return web.json_response(
                 {"ok": False, "error": f"Invalid transaction: {e}"})
-        result = await self._verify_and_push_tx(
+        result = await self._submit_tx(
             tx, request.headers.get("Sender-Node"))
         return web.json_response(result)
 
@@ -981,7 +1066,7 @@ class Node:
         except Exception as e:
             log.debug("send_to_address: tx build failed: %s", e)
             return web.json_response({"ok": False, "error": str(e)})
-        result = await self._verify_and_push_tx(
+        result = await self._submit_tx(
             tx, request.headers.get("Sender-Node"))
         return web.json_response(result)
 

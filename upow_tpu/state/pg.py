@@ -146,6 +146,8 @@ class PgChainState(StateViews):
         self._txn_owner = None
         self._index_mutations = 0  # dirty counter: rollback only pays
         # the full index resync if the transaction actually touched it
+        self._pending_gen = 0  # bumped on every LOCAL mempool mutation
+        self.reinject_reorg_txs = False  # Node flips this from config
 
     def _writer(self):
         if self._write_lock is None:
@@ -582,6 +584,7 @@ class PgChainState(StateViews):
                 'INSERT INTO pending_spent_outputs (tx_hash, "index")'
                 " VALUES ($1,$2)",
                 [(i.tx_hash, i.index) for i in tx.inputs])
+        self._pending_gen += 1
 
     async def _pending_decoded(self) -> Dict[str, Tx]:
         rows = await self.drv.afetch(
@@ -624,14 +627,36 @@ class PgChainState(StateViews):
 
     async def get_pending_transactions_by_hash(self,
                                                hashes: List[str]) -> List[str]:
-        out = []
-        for h in hashes:
+        # chunked IN (...) — one round trip per 500 hashes instead of
+        # one per hash; request order (and duplicates) preserved
+        found: Dict[str, str] = {}
+        for i in range(0, len(hashes), 500):
+            chunk = list(dict.fromkeys(hashes[i:i + 500]))
+            ph = ",".join(f"${j + 1}" for j in range(len(chunk)))
             rows = await self.drv.afetch(
-                "SELECT tx_hex FROM pending_transactions WHERE tx_hash = $1",
-                (h,))
-            if rows:
-                out.append(rows[0]["tx_hex"])
-        return out
+                "SELECT tx_hash, tx_hex FROM pending_transactions"
+                f" WHERE tx_hash IN ({ph})", chunk)
+            for r in rows:
+                found[r["tx_hash"]] = r["tx_hex"]
+        return [found[h] for h in hashes if h in found]
+
+    async def pending_journal_stamp(self) -> tuple:
+        """Cheap change stamp over the pending journal (see the sqlite
+        twin).  pg has no rowid, so MAX(tx_hash) stands in for it; the
+        local generation counter still catches same-count same-max
+        rewrites made through this process."""
+        rows = await self.drv.afetch(
+            "SELECT COUNT(*) AS c, COALESCE(MAX(tx_hash), '') AS m"
+            " FROM pending_transactions")
+        return (rows[0]["c"], rows[0]["m"], self._pending_gen)
+
+    async def load_pending_journal(self) -> List[dict]:
+        """Full journal rows for pool recovery/reconcile; NUMERIC fees
+        come back in coins and are converted to integer units."""
+        rows = await self.drv.afetch(
+            "SELECT tx_hash, tx_hex, fees FROM pending_transactions")
+        return [{"tx_hash": r["tx_hash"], "tx_hex": r["tx_hex"],
+                 "fees": _units(r["fees"])} for r in rows]
 
     async def get_pending_spent_outpoints(self, outpoints=None) -> set:
         """Pending-spent overlay; ``outpoints`` narrows the fetch to one
@@ -653,6 +678,7 @@ class PgChainState(StateViews):
                                                   hashes: List[str]) -> None:
         async with self._txn():
             await self._remove_pending_by_hash_locked(hashes)
+        self._pending_gen += 1
 
     async def _remove_pending_by_hash_locked(self, hashes: List[str]) -> None:
         for i in range(0, len(hashes), 500):
@@ -678,6 +704,7 @@ class PgChainState(StateViews):
         async with self._txn():
             await self.drv.aexecute("DELETE FROM pending_transactions")
             await self.drv.aexecute("DELETE FROM pending_spent_outputs")
+        self._pending_gen += 1
 
     async def get_pending_transactions_count(self) -> int:
         rows = await self.drv.afetch(

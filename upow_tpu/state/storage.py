@@ -177,6 +177,10 @@ class ChainState(StateViews):
         self._pending_cache: Optional[Dict[str, Tx]] = None
         self._pending_stamp: tuple = (-1, -1, -1)
         self._pending_gen = 0  # bumped on every LOCAL mempool mutation
+        # reorg mempool re-injection (mempool subsystem policy; the Node
+        # turns it on from MempoolConfig — off at the library level so
+        # state-only embedders keep the reference rollback semantics)
+        self.reinject_reorg_txs = False
         from collections import OrderedDict as _OD
 
         self._amount_cache: "_OD[tuple, object]" = _OD()
@@ -445,9 +449,54 @@ class ChainState(StateViews):
         )
         self.db.execute("DELETE FROM blocks WHERE id >= ?", (from_block_id,))
         self._amount_cache_drop(created)
+        if self.reinject_reorg_txs:
+            # mempool re-injection: txs the losing fork confirmed go
+            # back into the pending journal (their spent outputs were
+            # just restored above) so the winning fork can mine them
+            # instead of silently dropping user transactions.  Skips
+            # txs that spend an output of another removed tx (source
+            # gone) or conflict with the existing pending overlay.
+            for tx in txs:
+                if tx.is_coinbase or any(
+                        i.tx_hash in created_set for i in tx.inputs):
+                    continue
+                await self._reinject_pending(tx)
         self._bump_fees_gen()
+        self._pending_gen += 1
         self._commit()
         self._index_rebuild()  # reorgs are rare; a bulk resync is ms
+
+    async def _reinject_pending(self, tx) -> bool:
+        """INSERT-OR-IGNORE a reorged-out tx back into the journal.
+        Returns True when the row (and its spent-output overlay rows)
+        actually landed."""
+        outpoints = [i.outpoint for i in tx.inputs]
+        if await self.get_pending_spent_outpoints(outpoints):
+            return False  # conflicts with a live pending tx
+        try:
+            inputs_addresses = [
+                await self.resolve_output_address(i.tx_hash, i.index) or ""
+                for i in tx.inputs
+            ]
+            fees = await self.tx_fees(tx)
+        except (ValueError, KeyError, IndexError):
+            return False  # source txs unresolvable post-rollback
+        cur = self.db.execute(
+            "INSERT OR IGNORE INTO pending_transactions (tx_hash, tx_hex,"
+            " inputs_addresses, fees, propagation_time) VALUES (?,?,?,?,?)",
+            (tx.hash(), tx.hex(), json.dumps(inputs_addresses), fees,
+             now_ts()),
+        )
+        if cur.rowcount == 0:
+            return False  # already pending (re-propagated meanwhile)
+        self.db.executemany(
+            "INSERT INTO pending_spent_outputs (tx_hash, idx) VALUES (?,?)",
+            [(i.tx_hash, i.index) for i in tx.inputs],
+        )
+        from .. import trace
+
+        trace.inc("mempool.reinjected")
+        return True
 
     async def _restore_spent_outputs(self, inputs: List[TxInput]) -> None:
         """Re-materialize spent outputs by decoding their source txs."""
@@ -640,14 +689,18 @@ class ChainState(StateViews):
         return [tx_from_hex(h, check_signatures=False) for h in out]
 
     async def get_pending_transactions_by_hash(self, hashes: List[str]) -> List[str]:
-        out = []
-        for h in hashes:
-            r = self.db.execute(
-                "SELECT tx_hex FROM pending_transactions WHERE tx_hash = ?", (h,)
-            ).fetchone()
-            if r is not None:
-                out.append(r["tx_hex"])
-        return out
+        """Batched: chunked ``IN (...)`` like the removal path instead of
+        one SELECT per hash (push_block resolves up to a whole block's
+        txs through here).  Found hexes come back in request order."""
+        found: Dict[str, str] = {}
+        for i in range(0, len(hashes), 500):
+            chunk = hashes[i:i + 500]
+            ph = ",".join("?" * len(chunk))
+            for r in self.db.execute(
+                    "SELECT tx_hash, tx_hex FROM pending_transactions"
+                    f" WHERE tx_hash IN ({ph})", chunk):
+                found[r["tx_hash"]] = r["tx_hex"]
+        return [found[h] for h in hashes if h in found]
 
     async def get_pending_spent_outpoints(self, outpoints=None) -> set:
         """Pending-spent overlay; with ``outpoints`` only the matching
@@ -713,6 +766,29 @@ class ChainState(StateViews):
     async def get_pending_transactions_count(self) -> int:
         return self.db.execute(
             "SELECT COUNT(*) AS c FROM pending_transactions").fetchone()["c"]
+
+    # The pending_transactions table doubles as the mempool subsystem's
+    # write-behind journal (upow_tpu/mempool/): the in-memory pool is
+    # the read authority, this table provides restart recovery and the
+    # wallet CLI's direct-insert interop.  The stamp below is how the
+    # pool detects journal movement it did not make itself — same
+    # (count, max rowid, local generation) triple _pending_decoded uses.
+
+    async def pending_journal_stamp(self) -> tuple:
+        """Cheap change detector for the mempool journal."""
+        r = self.db.execute(
+            "SELECT COUNT(*) AS c, COALESCE(MAX(rowid), 0) AS m"
+            " FROM pending_transactions").fetchone()
+        return (r["c"], r["m"], self._pending_gen)
+
+    async def load_pending_journal(self) -> List[dict]:
+        """Every journal row the pool needs to rebuild itself
+        (recovery load at startup, stamp-triggered reconcile after)."""
+        rows = self.db.execute(
+            "SELECT tx_hash, tx_hex, fees FROM pending_transactions"
+        ).fetchall()
+        return [{"tx_hash": r["tx_hash"], "tx_hex": r["tx_hex"],
+                 "fees": r["fees"]} for r in rows]
 
     async def get_need_propagate_transactions(self, older_than: int = 300) -> List[str]:
         """Piggyback re-propagation queue (reference database.py:188-207)."""

@@ -14,6 +14,10 @@ around block creation (manager.py:655, 732-736) and UTXO deletes
 * :func:`inc` / :func:`counters` — process-wide event counters (retries,
   breaker trips, device degradations, injected faults) exported on
   ``/metrics`` as ``upow_<name>_total`` and asserted by the chaos suite.
+* :func:`observe` / :func:`histograms` — fixed-bucket histograms
+  (mempool admission latency, intake batch sizes) exported on
+  ``/metrics`` in Prometheus cumulative-bucket form
+  (``upow_<name>_bucket{le="..."}`` + ``_sum`` + ``_count``).
 """
 
 from __future__ import annotations
@@ -68,9 +72,51 @@ def counters() -> Dict[str, int]:
     return dict(_counters)
 
 
+# Default buckets suit sub-second latencies; size-like metrics (batch
+# sizes, queue depths) pass their own buckets on first observe.
+_DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+_hists: Dict[str, dict] = {}
+
+
+def observe(name: str, value, buckets=None) -> None:
+    """Record ``value`` into the named histogram.
+
+    Bucket bounds are fixed by the FIRST observation of each name
+    (later ``buckets`` arguments are ignored) — Prometheus scrapes
+    cannot follow bounds that change between exports.  Same locking
+    stance as :func:`inc`: a lost update only skews observability.
+    """
+    h = _hists.get(name)
+    if h is None:
+        bounds = tuple(sorted(buckets)) if buckets else _DEFAULT_BUCKETS
+        h = _hists[name] = {"bounds": bounds,
+                            "counts": [0] * (len(bounds) + 1),
+                            "sum": 0.0, "count": 0}
+    for i, bound in enumerate(h["bounds"]):
+        if value <= bound:
+            h["counts"][i] += 1
+            break
+    else:
+        h["counts"][-1] += 1  # +Inf overflow bucket
+    h["sum"] += value
+    h["count"] += 1
+
+
+def histograms() -> Dict[str, dict]:
+    """Snapshot: {name: {bounds, counts (per-bucket, +Inf last), sum,
+    count}}.  Counts are per-bucket, not cumulative — the /metrics
+    exporter does the cumulative sum the Prometheus format wants."""
+    return {k: {"bounds": v["bounds"], "counts": list(v["counts"]),
+                "sum": v["sum"], "count": v["count"]}
+            for k, v in _hists.items()}
+
+
 def reset() -> None:
     _stats.clear()
     _counters.clear()
+    _hists.clear()
 
 
 @contextmanager

@@ -706,8 +706,25 @@ class TxVerifier:
             device_timeout=self.verify_device_timeout,
             mesh_devices=self.verify_mesh_devices))
 
+    async def prepare_pending(self, tx: Tx) -> Optional[List[tuple]]:
+        """Host-side half of add-pending verification: every rule check
+        plus the pending-double-spend overlay, with the signature work
+        COLLECTED but not dispatched.  The mempool intake flattens the
+        returned check tuples across a whole micro-batch into one
+        ``run_sig_checks_async`` call; ``None`` means the tx failed a
+        host-side rule and never reaches the device."""
+        if not await self.rules_ok(tx, verifying_add_pending=True):
+            return None
+        if not await self.no_pending_double_spend(tx):
+            return None
+        return await self.collect_sig_checks(tx)
+
     async def verify_pending(self, tx: Tx, sig_backend: str = "auto") -> bool:
         """add-pending intake check (transaction.py:481-482)."""
-        return (await self.verify(tx, verifying_add_pending=True,
-                                  sig_backend=sig_backend)
-                and await self.no_pending_double_spend(tx))
+        checks = await self.prepare_pending(tx)
+        if checks is None:
+            return False
+        return all(await run_sig_checks_async(
+            checks, backend=sig_backend, pad_block=self.verify_pad_block,
+            device_timeout=self.verify_device_timeout,
+            mesh_devices=self.verify_mesh_devices))
