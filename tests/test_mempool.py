@@ -29,8 +29,8 @@ from upow_tpu.core.constants import MAX_BLOCK_SIZE_HEX
 from upow_tpu.core.header import BlockHeader
 from upow_tpu.core.merkle import merkle_root
 from upow_tpu.core.tx import Tx, TxInput, TxOutput
-from upow_tpu.mempool import (Mempool, MempoolEntry, TTLSet,
-                              assemble_template, select_reference)
+from upow_tpu.mempool import (IntakeCoordinator, Mempool, MempoolEntry,
+                              TTLSet, assemble_template, select_reference)
 from upow_tpu.mine.engine import MiningJob, mine
 from upow_tpu.node.app import GENESIS_PREV_HASH, Node
 from upow_tpu.state.storage import ChainState
@@ -192,6 +192,25 @@ def test_template_equals_reference_without_dependencies():
             select_reference(ranked, cap), cap
 
 
+def test_template_requeues_child_with_multiple_pooled_parents():
+    """A child spending TWO pooled parents must pack once both land:
+    popping it when the first parent packs may not drop it — it moves
+    to the next missing parent's queue (regression: it was discarded,
+    never packing even though every parent made the block)."""
+    parent_a = MempoolEntry(tx_hash="aa" * 32, tx_hex="0" * 100, fees=50)
+    parent_b = MempoolEntry(tx_hash="bb" * 32, tx_hex="1" * 100, fees=1)
+    child = MempoolEntry(tx_hash="cc" * 32, tx_hex="2" * 100, fees=90,
+                         outpoints=(("aa" * 32, 0), ("bb" * 32, 0)))
+    ranked = sorted([parent_a, parent_b, child], key=lambda e: e.sort_key)
+    assert ranked[0] is child  # child outranks both parents
+    packed = assemble_template(ranked, 10_000)
+    assert [e.tx_hash for e in packed] == \
+        [parent_a.tx_hash, parent_b.tx_hash, child.tx_hash]
+    # second parent misses the cap -> child still correctly dropped
+    packed = assemble_template(ranked, 150)
+    assert [e.tx_hash for e in packed] == [parent_a.tx_hash]
+
+
 def test_template_packs_parent_before_child():
     parent = MempoolEntry(tx_hash="aa" * 32, tx_hex="0" * 100, fees=1)
     child = MempoolEntry(tx_hash="bb" * 32, tx_hex="1" * 100, fees=90,
@@ -282,6 +301,78 @@ def test_journal_rebuilds_pool_after_crash(tmp_path, keys):
         # second sync with an unchanged journal is a cheap no-op
         assert await pool.sync(state2) is False
         state2.close()
+
+    asyncio.run(main())
+
+
+def test_reconcile_never_absorbs_external_journal_mutation(tmp_path, keys):
+    """The intake batch ends by predicting the stamp its own writes
+    produced and reconciling.  A foreign journal mutation interleaved
+    with the batch (block acceptance deleting a mined tx) must be
+    diffed into the pool — blind-writing the observed stamp would make
+    every later sync() a no-op and keep serving the mined tx."""
+    async def main():
+        state = ChainState()
+        d, pub = curve.keygen(rng=4242)
+        addr = keys["addr"]
+        fan = await _funded_fanout(state, d, pub, addr, 4)
+        leaves = [_leaf(fan, k, addr, d, pub) for k in range(3)]
+
+        await state.add_pending_transaction(leaves[0])
+        pool = Mempool()
+        await pool.sync(state)
+        stamp0 = pool.journal_stamp
+
+        def entry(tx):
+            return MempoolEntry(
+                tx_hash=tx.hash(), tx_hex=tx.hex(), fees=0,
+                outpoints=tuple(i.outpoint for i in tx.inputs), tx=tx)
+
+        # undisturbed batch: prediction matches, no reload, no drift
+        seq = await state.add_pending_transaction(leaves[1])
+        pool.add(entry(leaves[1]))
+        expected = (stamp0[0] + 1, seq, stamp0[2] + 1)
+        assert await pool.reconcile(state, expected) is False
+        assert pool.journal_stamp == expected
+        assert await pool.sync(state) is False  # stamp is truthful
+
+        # disturbed batch: a block acceptance removes leaves[0] from
+        # the journal between this batch's awaits
+        stamp1 = pool.journal_stamp
+        seq = await state.add_pending_transaction(leaves[2])
+        pool.add(entry(leaves[2]))
+        await state.remove_pending_transactions_by_hash(
+            [leaves[0].hash()])  # the foreign writer
+        expected = (stamp1[0] + 1, seq, stamp1[2] + 1)
+        assert await pool.reconcile(state, expected) is True  # full diff ran
+        assert leaves[0].hash() not in pool  # mined tx did NOT survive
+        assert leaves[1].hash() in pool and leaves[2].hash() in pool
+        # an unpredictable batch (None) must also reconcile, not absorb
+        await state.add_pending_transaction(_leaf(fan, 3, addr, d, pub))
+        assert await pool.reconcile(state, None) is True
+        state.close()
+
+    asyncio.run(main())
+
+
+def test_block_accept_drops_mined_txs_from_pool_directly(tmp_path, keys):
+    """BlockManager.on_pending_removed → Mempool.remove: a mined tx
+    leaves the pool the moment its block commits, with no sync()."""
+    async def main():
+        state = ChainState()
+        manager = BlockManager(state)
+        pool = Mempool()
+        manager.on_pending_removed = pool.remove
+        d, pub = curve.keygen(rng=4242)
+        addr = keys["addr"]
+        fan = await _funded_fanout(state, d, pub, addr, 2)
+        leaf = _leaf(fan, 0, addr, d, pub)
+        await state.add_pending_transaction(leaf)
+        await pool.sync(state)
+        assert leaf.hash() in pool
+        await _mine_block(state, manager, addr, [leaf])
+        assert leaf.hash() not in pool  # direct notification, no sync
+        state.close()
 
     asyncio.run(main())
 
@@ -427,3 +518,95 @@ def test_intake_dispatch_count_and_serial_parity(tmp_path, keys, monkeypatch):
         assert len(journal) == 32
 
     run_cluster(tmp_path, scenario)
+
+
+class _IntakeNode:
+    """Minimal duck-typed Node for driving IntakeCoordinator directly."""
+
+    def __init__(self, state, config):
+        self.state = state
+        self.config = config
+        self.pool = Mempool()
+        self.tx_cache = TTLSet()
+        self._background = set()
+
+    def make_tx_verifier(self):
+        return txverify.TxVerifier(self.state)
+
+    async def accept_tx_effects(self, tx, tx_hash, first_address, sender):
+        pass
+
+
+def test_cancelled_drainer_resolves_inflight_waiters(
+        tmp_path, keys, monkeypatch):
+    """A drainer cancelled mid-batch (Node.close during the signature
+    dispatch) has already popped the batch off the queue; its waiters
+    must still resolve instead of hanging their handlers forever."""
+    async def main():
+        state = ChainState()
+        d, pub = curve.keygen(rng=4242)
+        addr = keys["addr"]
+        fan = await _funded_fanout(state, d, pub, addr, 2)
+        cfg = make_config(tmp_path, "intake-cancel")
+        cfg.mempool.coalesce_window_ms = 0
+        node = _IntakeNode(state, cfg)
+
+        started = asyncio.Event()
+
+        async def stuck(checks, **kw):
+            started.set()
+            await asyncio.Event().wait()  # a wedged device dispatch
+
+        monkeypatch.setattr(txverify, "run_sig_checks_async", stuck)
+        coordinator = IntakeCoordinator(node)
+        waiter = asyncio.ensure_future(
+            coordinator.submit(_leaf(fan, 0, addr, d, pub), None))
+        await asyncio.wait_for(started.wait(), timeout=10)
+        coordinator._drainer.cancel()
+        result = await asyncio.wait_for(waiter, timeout=10)
+        assert result == {"ok": False,
+                          "error": "Transaction has not been added"}
+        state.close()
+
+    asyncio.run(main())
+
+
+def test_journal_only_row_reports_already_present(
+        tmp_path, keys, monkeypatch):
+    """Serial parity for a journal row the pool dropped as a sync
+    conflict: the serial path's pending_transaction_exists check says
+    "Transaction already present", so the batched path must too."""
+    async def main():
+        state = ChainState()
+        d, pub = curve.keygen(rng=4242)
+        addr = keys["addr"]
+        fan = await _funded_fanout(state, d, pub, addr, 2)
+        # two competing spends of the same outpoint, both journaled
+        # (external writers bypass the pool's conflict map)
+        leaf_a = _leaf(fan, 0, addr, d, pub)
+        leaf_b = Tx([TxInput(fan.hash(), 0)],
+                    [TxOutput(addr, fan.outputs[0].amount - 1)]).sign(
+                        [d], lambda _i: pub)
+        await state.add_pending_transaction(leaf_a)
+        await state.add_pending_transaction(leaf_b)
+        cfg = make_config(tmp_path, "intake-journal-only")
+        cfg.mempool.coalesce_window_ms = 0
+        node = _IntakeNode(state, cfg)
+        await node.pool.sync(state)
+        winner, loser = ((leaf_a, leaf_b) if leaf_a.hash() in node.pool
+                         else (leaf_b, leaf_a))
+        assert loser.hash() not in node.pool  # conflict-skipped
+        assert await state.pending_transaction_exists(loser.hash())
+
+        async def no_dispatch(checks, **kw):
+            raise AssertionError("duplicate must not reach the device")
+
+        monkeypatch.setattr(txverify, "run_sig_checks_async", no_dispatch)
+        coordinator = IntakeCoordinator(node)
+        for tx in (loser, winner):  # journal-only and pooled agree
+            result = await coordinator.submit(tx, None)
+            assert result == {"ok": False,
+                              "error": "Transaction already present"}, tx
+        state.close()
+
+    asyncio.run(main())

@@ -291,6 +291,74 @@ def test_mempool_ordering_and_propagation(make_state):
     run(main())
 
 
+def test_journal_stamp_detects_count_preserving_rewrite(make_state):
+    """A cross-process writer deleting a non-max journal row and
+    inserting a new one preserves COUNT(*) and MAX(tx_hash) and never
+    touches this process's generation counter — the old pg stamp was
+    blind to exactly this.  The monotonic journal sequence (sqlite
+    rowid / pg journal_seq) must move anyway."""
+    from upow_tpu.core.clock import timestamp as now_ts
+    from upow_tpu.state.pgdriver import _utc
+
+    async def raw_insert(state, tx_hash):
+        if hasattr(state, "drv"):
+            await state.drv.aexecute(
+                "INSERT INTO pending_transactions (tx_hash, tx_hex,"
+                " inputs_addresses, fees, propagation_time)"
+                " VALUES ($1,$2,$3,$4,$5)",
+                (tx_hash, "00", [], Decimal("0"), _utc(now_ts())))
+        else:
+            state.db.execute(
+                "INSERT INTO pending_transactions (tx_hash, tx_hex,"
+                " inputs_addresses, fees, propagation_time)"
+                " VALUES (?,?,?,?,?)", (tx_hash, "00", "[]", 0, now_ts()))
+            state._commit()
+
+    async def raw_delete(state, tx_hash):
+        if hasattr(state, "drv"):
+            await state.drv.aexecute(
+                "DELETE FROM pending_transactions WHERE tx_hash = $1",
+                (tx_hash,))
+        else:
+            state.db.execute(
+                "DELETE FROM pending_transactions WHERE tx_hash = ?",
+                (tx_hash,))
+            state._commit()
+
+    async def main():
+        state = make_state()
+        manager = BlockManager(state, sig_backend="host")
+        builder = WalletBuilder(state)
+        actors = make_actors()
+        d_g, a_g = actors["genesis"]
+        for _ in range(4):
+            await mine_block(manager, state, a_g)
+
+        # add_pending_transaction hands back the journal sequence its
+        # insert drew — the value Mempool.reconcile's delta prediction
+        # needs — and the stamp's max agrees with it
+        tx = await builder.create_transaction(d_g, actors["outsider"][1], "1")
+        seq = await state.add_pending_transaction(tx)
+        assert isinstance(seq, int)
+        assert (await state.pending_journal_stamp())[1] == seq
+
+        # three foreign rows with controlled hash order: aa < bb < cc
+        for h in ("aa" * 32, "bb" * 32, "cc" * 32):
+            await raw_insert(state, h)
+        stamp0 = await state.pending_journal_stamp()
+
+        # the count-preserving rewrite: drop a NON-max row, add one
+        # that still sorts below the max ("ab" < "cc")
+        await raw_delete(state, "aa" * 32)
+        await raw_insert(state, "ab" * 32)
+        stamp1 = await state.pending_journal_stamp()
+        assert stamp1[0] == stamp0[0]  # COUNT(*) unchanged
+        assert stamp1[2] == stamp0[2]  # local gen never saw the writer
+        assert stamp1[1] > stamp0[1]   # ...but the sequence moved
+        assert stamp1 != stamp0
+    run(main())
+
+
 def test_cross_backend_fingerprint_equivalence(monkeypatch):
     """The same chain produces identical UTXO fingerprints and balances
     on the sqlite and postgres backends."""

@@ -139,6 +139,12 @@ class IntakeCoordinator:
         except Exception as e:  # no waiter may hang; mirror the serial
             # path's catch-all around verify (reject, don't 500)
             log.error("intake batch failed: %s", e, exc_info=True)
+        finally:
+            # covers BaseException too: a drainer cancelled mid-batch
+            # (Node.close) has already popped this batch off the queue,
+            # so _drain's CancelledError handler cannot see it — settle
+            # the in-flight waiters here before the cancellation
+            # propagates, or their handler coroutines hang forever
             for req in batch:
                 if not req.fut.done():
                     self._resolve(req, _reject(ERR_NOT_ADDED))
@@ -161,8 +167,12 @@ class IntakeCoordinator:
                 return
 
         # pull in external journal writers (wallet CLI, block accept)
-        # before membership checks — the pool is the intake authority
+        # before membership checks — the pool is the intake authority.
+        # stamp0 anchors the end-of-batch reconcile: the batch predicts
+        # the stamp its own writes produce from here, and any deviation
+        # means a foreign writer interleaved with the awaits below.
         await node.pool.sync(node.state)
+        stamp0 = node.pool.journal_stamp
 
         # -- phase A: per-tx host-side checks, batch order -----------------
         seen: Dict[str, _Req] = {}
@@ -189,6 +199,14 @@ class IntakeCoordinator:
                 self._resolve(req, _reject(ERR_FORBIDDEN))
                 continue
             if req.tx_hash in node.pool:
+                self._resolve(req, _reject(ERR_PRESENT))
+                continue
+            if await node.state.pending_transaction_exists(req.tx_hash):
+                # journal row the pool does NOT hold (a conflict-skipped
+                # loser from sync's reconcile): the serial path's
+                # pending_transaction_exists check answers ERR_PRESENT
+                # here, so the batched path must too — not the
+                # double-spend/UNIQUE reject it would otherwise hit
                 self._resolve(req, _reject(ERR_PRESENT))
                 continue
             try:
@@ -225,6 +243,8 @@ class IntakeCoordinator:
 
         # -- phase C: finalize in batch order ------------------------------
         claimed: Dict[tuple, str] = {}  # intra-batch outpoint claims
+        added = 0           # successful journal inserts this batch
+        last_seq = None     # journal sequence of the latest insert
         for req in survivors:
             lo, hi = req.slice
             if not all(verdicts[lo:hi]):
@@ -237,7 +257,8 @@ class IntakeCoordinator:
                 self._resolve(req, _reject(ERR_NOT_ADDED))
                 continue
             try:
-                await node.state.add_pending_transaction(req.tx)
+                last_seq = await node.state.add_pending_transaction(req.tx)
+                added += 1
             except Exception as e:  # serial parity (journal reject)
                 log.info("tx rejected %s: %s", req.tx_hash, e)
                 self._resolve(req, _reject(ERR_NOT_ADDED))
@@ -265,9 +286,22 @@ class IntakeCoordinator:
             else:
                 self._resolve(req, dict(first_result))
 
-        # the pool already contains this batch's writes — record the
-        # journal stamp so the next sync() is a no-op, then apply the
+        # the pool already contains this batch's writes — predict the
+        # stamp those writes alone would have produced from stamp0 (K
+        # inserts: count +K, max-seq = last insert's sequence, local
+        # generation +K) and reconcile.  A match records the stamp
+        # cheaply; ANY mismatch means a foreign journal writer (block
+        # acceptance deleting mined txs, a wallet-CLI insert) landed
+        # during one of this batch's awaits, and reconcile() falls back
+        # to the full sync diff instead of stamping the change over —
+        # a blind stamp write here would make every later sync() no-op
+        # and leave already-mined txs in mining templates.
+        expected = None
+        if (stamp0 is not None and len(stamp0) == 3
+                and (added == 0 or last_seq is not None)):
+            expected = (stamp0[0] + added,
+                        last_seq if added else stamp0[1],
+                        stamp0[2] + added)
+        await node.pool.reconcile(node.state, expected)
         # byte cap and TTL (write-through: evictions leave the journal)
-        node.pool.mark_journal_stamp(
-            await node.state.pending_journal_stamp())
         await node.pool.enforce_limits(node.state)

@@ -108,6 +108,11 @@ class Mempool:
     def total_bytes_hex(self) -> int:
         return self._bytes
 
+    @property
+    def journal_stamp(self) -> Optional[tuple]:
+        """Last reconciled journal stamp (None before the first sync)."""
+        return self._journal_stamp
+
     def ordered(self) -> List[MempoolEntry]:
         """Entries in reference priority order (rate DESC, hash ASC)."""
         return [self._entries[key[1]] for key in self._order]
@@ -218,7 +223,7 @@ class Mempool:
 
     # -------------------------------------------------- journal reconcile --
 
-    async def sync(self, state) -> bool:
+    async def sync(self, state, _stamp: Optional[tuple] = None) -> bool:
         """Reconcile pool content against the write-behind journal.
 
         Cheap no-op when the journal stamp is unchanged.  On change
@@ -227,7 +232,8 @@ class Mempool:
         rows absent from the pool are parsed and added, pool entries
         gone from the journal are dropped.  Returns True when pool
         content changed (generation advanced)."""
-        stamp = await state.pending_journal_stamp()
+        stamp = _stamp if _stamp is not None \
+            else await state.pending_journal_stamp()
         if stamp == self._journal_stamp:
             return False
         gen0 = self.generation
@@ -252,19 +258,36 @@ class Mempool:
         self._journal_stamp = stamp
         return self.generation != gen0
 
-    def mark_journal_stamp(self, stamp: tuple) -> None:
-        """Record the stamp after intake's own write-through so the next
-        sync() doesn't re-diff changes this pool already contains."""
-        self._journal_stamp = stamp
+    async def reconcile(self, state,
+                        expected_stamp: Optional[tuple]) -> bool:
+        """Post-write-through stamp update that cannot absorb a foreign
+        journal mutation.  The caller predicts the stamp its OWN writes
+        should have produced (``expected_stamp``); when the observed
+        stamp matches exactly, it is recorded without reloading the
+        journal.  On ANY deviation — or when the caller could not
+        predict (``None``) — the full :meth:`sync` diff runs, so a
+        concurrent external write (block acceptance deleting mined txs,
+        a wallet-CLI insert) is diffed in rather than silently stamped
+        over.  Returns True when pool content changed."""
+        observed = await state.pending_journal_stamp()
+        if expected_stamp is not None and observed == expected_stamp:
+            self._journal_stamp = observed
+            return False
+        return await self.sync(state, _stamp=observed)
 
     async def enforce_limits(self, state) -> List[str]:
         """TTL + byte cap, with write-through to the journal so evicted
-        txs do not resurrect on the next stamp reconcile."""
+        txs do not resurrect on the next stamp reconcile.  The journal
+        removal ends with a full :meth:`sync` rather than a blind stamp
+        write: an external journal mutation landing between the DELETE
+        and the stamp read must be diffed in, not absorbed.  Evictions
+        only fire past the cap/TTL, so the reload stays off the common
+        path."""
         dropped = self.expire()
         dropped += self.evict_over_cap()
         if dropped:
             await state.remove_pending_transactions_by_hash(dropped)
-            self.mark_journal_stamp(await state.pending_journal_stamp())
+            await self.sync(state)
         return dropped
 
 

@@ -64,11 +64,16 @@ def assemble_template(entries: List[MempoolEntry],
         packed.append(entry)
         packed_set.add(entry.tx_hash)
         # unblock children whose last missing parent was this tx, in
-        # the priority order they were deferred in
+        # the priority order they were deferred in; a child with MORE
+        # unpacked parents moves to its next missing parent's queue
+        # (dropping it here would strand it even when every parent
+        # eventually packs)
         for child in waiting.pop(entry.tx_hash, []):
             missing = [h for h, _ in child.outpoints
                        if h in in_pool and h not in packed_set]
-            if not missing:
+            if missing:
+                waiting.setdefault(missing[0], []).append(child)
+            else:
                 try_pack(child)
         return True
 

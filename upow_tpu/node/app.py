@@ -148,6 +148,12 @@ class Node:
         mcfg = self.config.mempool
         self.pool = Mempool(max_bytes_hex=mcfg.max_pool_bytes_hex,
                             tx_ttl=mcfg.tx_ttl, allow_rbf=mcfg.allow_rbf)
+        if mcfg.enabled:
+            # block acceptance / mempool GC drop mined and doomed txs
+            # from the pool directly — templates stop serving a mined
+            # tx the moment its block commits, with the stamp-driven
+            # sync() kept as the reconciliation backstop
+            self.manager.on_pending_removed = self.pool.remove
         self.intake = IntakeCoordinator(self, _BANNED_ADDRESSES)
         self.mining_cache = MiningInfoCache()
         self.state.reinject_reorg_txs = bool(mcfg.enabled

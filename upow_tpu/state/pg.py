@@ -103,6 +103,18 @@ PG_SCHEMA = [
 ] + [
     "CREATE INDEX IF NOT EXISTS tx_hash_idx ON unspent_outputs (tx_hash)",
     "CREATE INDEX IF NOT EXISTS block_hash_idx ON transactions (block_hash)",
+] + [
+    # Beyond-reference migration (both statements idempotent, and a
+    # pre-existing uPow database picks the column up on first boot): a
+    # monotonic journal sequence for the mempool's change stamp.  pg has
+    # no rowid, and (COUNT, MAX(tx_hash)) is blind to a delete+insert
+    # that replaces a non-max row at the same count — MAX(journal_seq)
+    # moves on every insert because the sequence never hands a value
+    # out twice.  Reference writers that INSERT without naming the
+    # column draw the default, so wallet-CLI interop is unchanged.
+    "CREATE SEQUENCE IF NOT EXISTS pending_journal_seq",
+    "ALTER TABLE pending_transactions ADD COLUMN IF NOT EXISTS"
+    " journal_seq BIGINT DEFAULT nextval('pending_journal_seq')",
 ]
 
 
@@ -567,7 +579,13 @@ class PgChainState(StateViews):
 
     # ------------------------------------------------------------ mempool --
 
-    async def add_pending_transaction(self, tx: Tx) -> None:
+    async def add_pending_transaction(self, tx: Tx) -> Optional[int]:
+        """Insert one journal row; returns its journal_seq (see the
+        sqlite twin — the value the stamp's MAX(journal_seq) takes when
+        no foreign writer interleaved, used by Mempool.reconcile's
+        delta prediction).  Read back by tx_hash inside the same
+        transaction: a row's sequence is immutable once assigned, so
+        the read cannot be corrupted by concurrent writers."""
         inputs_addresses = [
             await self.resolve_output_address(i.tx_hash, i.index) or ""
             for i in tx.inputs
@@ -584,7 +602,11 @@ class PgChainState(StateViews):
                 'INSERT INTO pending_spent_outputs (tx_hash, "index")'
                 " VALUES ($1,$2)",
                 [(i.tx_hash, i.index) for i in tx.inputs])
+            rows = await self.drv.afetch(
+                "SELECT journal_seq AS s FROM pending_transactions"
+                " WHERE tx_hash = $1", (tx.hash(),))
         self._pending_gen += 1
+        return rows[0]["s"] if rows else None
 
     async def _pending_decoded(self) -> Dict[str, Tx]:
         rows = await self.drv.afetch(
@@ -642,11 +664,15 @@ class PgChainState(StateViews):
 
     async def pending_journal_stamp(self) -> tuple:
         """Cheap change stamp over the pending journal (see the sqlite
-        twin).  pg has no rowid, so MAX(tx_hash) stands in for it; the
-        local generation counter still catches same-count same-max
-        rewrites made through this process."""
+        twin).  MAX(journal_seq) plays the rowid's role, and is
+        strictly stronger: the sequence never reissues a value, so a
+        delete+insert rewrite always moves the max (sqlite rowid can be
+        reused when the max row is deleted).  The local generation
+        counter still covers same-process rewrites.  Rows predating the
+        journal_seq migration carry NULL and are masked by COALESCE
+        until the first post-migration insert."""
         rows = await self.drv.afetch(
-            "SELECT COUNT(*) AS c, COALESCE(MAX(tx_hash), '') AS m"
+            "SELECT COUNT(*) AS c, COALESCE(MAX(journal_seq), 0) AS m"
             " FROM pending_transactions")
         return (rows[0]["c"], rows[0]["m"], self._pending_gen)
 

@@ -310,7 +310,10 @@ _TIMESTAMP_COLS = {"timestamp", "propagation_time", "block_ts", "ts"}
 _BOOL_COLS = {"is_stake"}
 
 # sqlite DDL mirroring schema.sql's tables (same names, sqlite types);
-# "index" is kept verbatim — sqlite accepts it quoted.
+# "index" is kept verbatim — sqlite accepts it quoted.  journal_seq is
+# the PG_SCHEMA migration column (pg: BIGINT DEFAULT nextval); INTEGER
+# PRIMARY KEY AUTOINCREMENT reproduces the never-reissued monotonic
+# assignment the mempool stamp relies on.
 _MOCK_DDL = """
 CREATE TABLE IF NOT EXISTS blocks (
     id INTEGER PRIMARY KEY,
@@ -338,6 +341,7 @@ CREATE TABLE IF NOT EXISTS unspent_outputs (
     is_stake INTEGER
 );
 CREATE TABLE IF NOT EXISTS pending_transactions (
+    journal_seq INTEGER PRIMARY KEY AUTOINCREMENT,
     tx_hash TEXT UNIQUE,
     tx_hex TEXT,
     inputs_addresses TEXT,

@@ -644,23 +644,30 @@ class ChainState(StateViews):
 
     # ------------------------------------------------------------ mempool --
 
-    async def add_pending_transaction(self, tx: Tx) -> None:
+    async def add_pending_transaction(self, tx: Tx) -> int:
+        """Insert one journal row; returns its journal sequence (the
+        sqlite rowid) — with no interleaved foreign writer, the stamp's
+        MAX(rowid) after this call equals the returned value, which is
+        what lets the mempool intake predict the stamp its own batch
+        should produce (Mempool.reconcile)."""
         inputs_addresses = [
             await self.resolve_output_address(i.tx_hash, i.index) or ""
             for i in tx.inputs
         ]
         fees = await self.tx_fees(tx)
-        self.db.execute(
+        cur = self.db.execute(
             "INSERT INTO pending_transactions (tx_hash, tx_hex, inputs_addresses,"
             " fees, propagation_time) VALUES (?,?,?,?,?)",
             (tx.hash(), tx.hex(), json.dumps(inputs_addresses), fees, now_ts()),
         )
+        seq = cur.lastrowid
         self.db.executemany(
             "INSERT INTO pending_spent_outputs (tx_hash, idx) VALUES (?,?)",
             [(i.tx_hash, i.index) for i in tx.inputs],
         )
         self._commit()
         self._pending_gen += 1
+        return seq
 
     async def pending_transaction_exists(self, tx_hash: str) -> bool:
         r = self.db.execute(

@@ -94,6 +94,13 @@ class BlockManager:
         # transient page-level signature verdicts (chain-sync prefill):
         # set by the node's create_blocks around a page's accept loop
         self.page_sig_verdicts: Optional[dict] = None
+        # mempool notification: called with the tx hashes of every
+        # journal removal this manager performs (mined txs on block
+        # acceptance, GC evictions), AFTER the removal committed.  The
+        # node points this at Mempool.remove so the in-memory pool
+        # drops mined txs immediately instead of waiting for the next
+        # stamp reconcile to notice the journal moved.
+        self.on_pending_removed = None
         # one acceptance at a time: check_block suspends (sql, executor
         # dispatch), so two concurrent push_block handlers could both
         # validate against tip N and race the same block id into the
@@ -103,6 +110,10 @@ class BlockManager:
 
     def invalidate_difficulty(self):
         self._difficulty_cache = None
+
+    def _notify_pending_removed(self, hashes: List[str]) -> None:
+        if self.on_pending_removed is not None and hashes:
+            self.on_pending_removed(hashes)
 
     @staticmethod
     def device_health() -> dict:
@@ -324,6 +335,9 @@ class BlockManager:
                 await self.state.remove_pending_transactions_by_hash(
                     [tx.hash() for tx in transactions])
                 await self.state.remove_outputs(transactions)
+        # outside the atomic block: the pool must only drop entries for
+        # a COMMITTED acceptance
+        self._notify_pending_removed([tx.hash() for tx in transactions])
 
         if block_no % 10 == 0:
             fingerprint = await self.state.get_unspent_outputs_hash()
@@ -393,6 +407,7 @@ class BlockManager:
                 await self.state.remove_pending_transactions_by_hash(
                     [tx.hash() for tx in transactions])
                 await self.state.remove_outputs(transactions)
+        self._notify_pending_removed([tx.hash() for tx in transactions])
         self.invalidate_difficulty()
         return True
 
@@ -423,6 +438,7 @@ class BlockManager:
                 outpoints = [i.outpoint for i in tx.inputs]
                 if any(o in used for o in outpoints):
                     await self.state.remove_pending_transactions_by_hash([tx.hash()])
+                    self._notify_pending_removed([tx.hash()])
                     evicted = True
                     break
                 used.update(outpoints)
@@ -439,4 +455,5 @@ class BlockManager:
                 doomed = [h for h, ops in tx_map.items()
                           if any(o in missing for o in ops)]
                 await self.state.remove_pending_transactions_by_hash(doomed)
+                self._notify_pending_removed(doomed)
             return
