@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: lint test ruff
+.PHONY: lint test ruff metrics-check
 
 # Domain linter: consensus-endianness, consensus-purity, jit-purity,
 # dtype-hygiene, async-safety, broad-except.  Stdlib-only; exits 1 on
@@ -21,3 +21,9 @@ ruff:
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# Boots an in-process node and validates its /metrics end to end:
+# content type, exposition grammar, cumulative-bucket invariants, and
+# the required kernel/chain metric families (docs/OBSERVABILITY.md).
+metrics-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.telemetry.selfcheck

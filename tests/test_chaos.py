@@ -24,7 +24,7 @@ import time
 
 import pytest
 
-from upow_tpu import trace
+from upow_tpu import telemetry, trace
 from upow_tpu.config import NodeConfig, ResilienceConfig
 from upow_tpu.core import curve
 from upow_tpu.node.peers import NodeInterface
@@ -249,8 +249,11 @@ def test_device_failure_cpu_fallback_then_recovery(tmp_path, monkeypatch):
             faultinject.install("device.verify:error:times=2", seed=11)
 
             def verify():
-                return txverify.run_sig_checks(checks, backend="device",
-                                               use_cache=False)
+                # traced like a real request so the degrade/fault events
+                # emitted underneath carry a trace ID (/debug/events)
+                with telemetry.request_trace("chaos.device_verify"):
+                    return txverify.run_sig_checks(checks, backend="device",
+                                                   use_cache=False)
 
             # failures 1 and 2: device dispatch errors, host fallback
             # still produces correct verdicts; the second failure trips
@@ -289,6 +292,21 @@ def test_device_failure_cpu_fallback_then_recovery(tmp_path, monkeypatch):
             metrics = await (await client.get("/metrics")).text()
             assert "upow_device_verify_health 0" in metrics
             assert "upow_resilience_device_recovered_total 1" in metrics
+
+            # the degrade arc and the injected faults are structured
+            # events at /debug/events, each tied to the verify trace
+            res = await (await client.get(
+                "/debug/events", params={"kind": "degrade"})).json()
+            assert res["ok"]
+            arc = [(e["previous"], e["state"]) for e in res["result"]]
+            assert arc == [("ok", "degraded"), ("degraded", "ok")], arc
+            assert all(e["trace_id"] for e in res["result"])
+            res = await (await client.get(
+                "/debug/events", params={"kind": "fault_injected"})).json()
+            dev = [e for e in res["result"]
+                   if e["site"] == "device.verify"]
+            assert len(dev) == 2
+            assert all(e["trace_id"] for e in dev)
         finally:
             faultinject.uninstall()
 
@@ -387,5 +405,16 @@ def test_mempool_flood_with_intake_faults(tmp_path, keys):
                    for r in await node.state.load_pending_journal()}
         assert {e.tx_hash for e in node.pool.ordered()} == journal
         assert journal == accepted
+
+        # every injected intake fault surfaced at /debug/events, tied to
+        # the trace of a request in the faulted micro-batch
+        res = await (await client.get(
+            "/debug/events", params={"kind": "fault_injected"})).json()
+        assert res["ok"]
+        intake_events = [e for e in res["result"]
+                         if e["site"] == "mempool.intake"]
+        errors = [e for e in intake_events if e["fault"] == "error"]
+        assert len(errors) == 2
+        assert all(e["trace_id"] for e in intake_events)
 
     run_cluster(tmp_path, scenario)

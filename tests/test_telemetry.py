@@ -1,0 +1,372 @@
+"""Telemetry subsystem tests: trace trees, cross-node trace-ID
+propagation, Prometheus exposition format, cardinality bounds, events,
+and the JSONL log formatter.
+
+The end-to-end tests drive real in-process nodes (the test_node
+Cluster harness) so the spans asserted here come from the actual
+push_tx intake path, and the gossip hop carries a real X-Upow-Trace
+header over localhost HTTP.
+"""
+
+import asyncio
+import json
+import logging
+
+import pytest
+
+from test_node import (Cluster, easy_difficulty, keys, make_config,  # noqa: F401
+                       mine_via_api, run_cluster)
+from upow_tpu import telemetry
+from upow_tpu.logger import JsonlFormatter
+from upow_tpu.telemetry import events, exposition, metrics, tracing
+from upow_tpu.wallet.builders import WalletBuilder
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    """Registries are process-global: isolate each test."""
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.configure()  # restore preregistered kernel families
+
+
+def _find_roots(snapshot: dict, name: str) -> list:
+    seen, out = set(), []
+    for t in snapshot["recent"] + snapshot["slowest"]:
+        key = (t.get("trace_id"), t["start_ts"], t["name"])
+        if t["name"] == name and key not in seen:
+            seen.add(key)
+            out.append(t)
+    return out
+
+
+def _span_names(t: dict) -> list:
+    out = []
+    for child in t.get("spans", ()):
+        out.append(child["name"])
+        out.extend(_span_names(child))
+    return out
+
+
+# ------------------------------------------------- end-to-end traces ----
+
+def test_push_tx_trace_tree_and_gossip_header(tmp_path, keys):
+    """THE acceptance path: one push_tx yields a trace tree with >= 3
+    nested spans, and the gossip fan-out to a peer carries the same
+    trace ID in X-Upow-Trace (the peer's adopted root proves it)."""
+    async def scenario(cluster):
+        node_a, client_a = await cluster.add_node("a")
+        node_b, client_b = await cluster.add_node("b")
+        node_a.peers.add(cluster.url(1))
+        await mine_via_api(client_a, keys["addr"])
+
+        telemetry.reset()  # drop mining-era traces; keep only the push
+        builder = WalletBuilder(node_a.state)
+        tx = await builder.create_transaction(keys["d"], keys["addr2"],
+                                              "1.5")
+        resp = await client_a.get("/push_tx",
+                                  params={"tx_hex": tx.hex()})
+        res = await resp.json()
+        assert res["ok"], res
+        tid = resp.headers.get(telemetry.TRACE_HEADER)
+        assert tracing.valid_trace_id(tid)
+
+        # wait for the gossip hop to land on B
+        for _ in range(100):
+            pending = (await (await client_b.get(
+                "/get_pending_transactions")).json())["result"]
+            if tx.hex() in pending:
+                break
+            await asyncio.sleep(0.05)
+        else:
+            pytest.fail("gossiped tx never reached peer")
+
+        res = await (await client_a.get("/debug/traces")).json()
+        assert res["ok"]
+        roots = _find_roots(res["result"], "http.push_tx")
+        mine = [t for t in roots if t.get("trace_id") == tid]
+        # A's own request plus B's adopted gossip request (both nodes
+        # share this process's buffer) — two roots, one trace ID.
+        assert len(mine) >= 2, roots
+        by_depth = max(mine, key=lambda t: len(_span_names(t)))
+        names = _span_names(by_depth)
+        assert len(names) >= 3, names
+        assert "intake.queue_wait" in names
+        assert "intake.sig_dispatch" in names
+        assert "push_tx.journal_write" in names
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_serial_path_spans(tmp_path, keys):
+    """With the batched mempool off, the serial reference path still
+    produces a nested trace tree."""
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        node.config.mempool.enabled = False
+        await mine_via_api(client, keys["addr"])
+        telemetry.reset()
+        builder = WalletBuilder(node.state)
+        tx = await builder.create_transaction(keys["d"], keys["addr2"],
+                                              "2")
+        res = await (await client.get(
+            "/push_tx", params={"tx_hex": tx.hex()})).json()
+        assert res["ok"], res
+        res = await (await client.get("/debug/traces")).json()
+        roots = _find_roots(res["result"], "http.push_tx")
+        assert roots
+        names = _span_names(roots[0])
+        assert {"push_tx.verify", "push_tx.journal_write",
+                "push_tx.effects"} <= set(names), names
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_debug_events_endpoint(tmp_path, keys):
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        telemetry.event("reorg", from_block="aa" * 32, removed_txs=3)
+        telemetry.event("breaker", peer="x", state="open")
+        res = await (await client.get("/debug/events")).json()
+        assert res["ok"]
+        kinds = [e["kind"] for e in res["result"]]
+        assert "reorg" in kinds and "breaker" in kinds
+        res = await (await client.get(
+            "/debug/events", params={"kind": "reorg", "limit": "5"})).json()
+        assert [e["kind"] for e in res["result"]] == ["reorg"]
+        assert res["result"][0]["removed_txs"] == 3
+
+    run_cluster(tmp_path, scenario)
+
+
+# ------------------------------------------------ exposition format ----
+
+REQUIRED_FAMILIES = (
+    "upow_kernel_p256_verify_occupancy_bucket",
+    "upow_kernel_sha256_txid_occupancy_bucket",
+    "upow_kernel_p256_verify_compile_cache_hits_total",
+    "upow_kernel_p256_verify_compile_cache_misses_total",
+    "upow_block_height",
+    "upow_mempool_transactions",
+)
+
+
+def test_metrics_exposition_valid(tmp_path, keys):
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        await mine_via_api(client, keys["addr"])
+        resp = await client.get("/metrics")
+        assert resp.headers["Content-Type"] == exposition.CONTENT_TYPE
+        text = await resp.text()
+        errors = exposition.validate(text)
+        assert not errors, errors
+        for family in REQUIRED_FAMILIES:
+            assert family in text, f"missing {family}"
+        # height gauge reflects the mined block
+        line = next(l for l in text.splitlines()
+                    if l.startswith("upow_block_height "))
+        assert float(line.split()[1]) >= 1
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_exposition_sanitize_and_render():
+    e = exposition.Exposition()
+    e.gauge("mempool.pool.bytes", 12, help_text="dotted name")
+    e.counter("weird name!", 3)
+    text = e.render()
+    assert "upow_mempool_pool_bytes 12" in text
+    assert "upow_weird_name__total 3" in text
+    assert not exposition.validate(text)
+
+
+def test_validator_catches_violations():
+    # illegal metric name
+    assert exposition.validate("9bad_name 1\n")
+    # non-monotone cumulative buckets
+    bad = (
+        'x_bucket{le="0.1"} 5\n'
+        'x_bucket{le="0.5"} 3\n'
+        'x_bucket{le="+Inf"} 5\n'
+        "x_sum 1\n"
+        "x_count 5\n")
+    assert exposition.validate(bad)
+    # missing +Inf bucket
+    bad = ('y_bucket{le="0.1"} 1\n'
+           "y_sum 1\ny_count 1\n")
+    assert exposition.validate(bad)
+    # le bounds out of order
+    bad = (
+        'z_bucket{le="0.5"} 1\n'
+        'z_bucket{le="0.1"} 1\n'
+        'z_bucket{le="+Inf"} 2\n'
+        "z_sum 1\nz_count 2\n")
+    assert exposition.validate(bad)
+    # _count disagreeing with the +Inf bucket
+    bad = (
+        'w_bucket{le="0.1"} 1\n'
+        'w_bucket{le="+Inf"} 2\n'
+        "w_sum 1\nw_count 5\n")
+    assert exposition.validate(bad)
+
+
+def test_exposition_histogram_cumulative():
+    e = exposition.Exposition()
+    e.histogram("lat", bounds=(0.1, 0.5), counts=[2, 1, 4],
+                total=7, summed=3.5)
+    text = e.render()
+    assert 'upow_lat_bucket{le="0.1"} 2' in text
+    assert 'upow_lat_bucket{le="0.5"} 3' in text
+    assert 'upow_lat_bucket{le="+Inf"} 7' in text
+    assert "upow_lat_count 7" in text
+    assert not exposition.validate(text)
+
+
+# ------------------------------------------------------ trace units ----
+
+def test_trace_tree_nesting_and_buffer():
+    tracing.configure(recent=2, slowest=2, max_spans=512)
+    with tracing.request_trace("req.a"):
+        with tracing.span("outer"):
+            with tracing.span("inner"):
+                pass
+    snap = tracing.traces()
+    t = snap["recent"][-1]
+    assert t["name"] == "req.a" and tracing.valid_trace_id(t["trace_id"])
+    assert t["spans"][0]["name"] == "outer"
+    assert t["spans"][0]["spans"][0]["name"] == "inner"
+    # ring bound: recent keeps only the last 2
+    for i in range(5):
+        with tracing.request_trace(f"req.{i}"):
+            pass
+    snap = tracing.traces()
+    assert len(snap["recent"]) == 2
+    assert len(snap["slowest"]) <= 2
+
+
+def test_trace_id_adoption_and_validation():
+    assert tracing.valid_trace_id("ab" * 16)
+    assert not tracing.valid_trace_id(None)
+    assert not tracing.valid_trace_id("xyz")
+    assert not tracing.valid_trace_id("AB" * 16)  # upper-case rejected
+    with tracing.request_trace("r", trace_id="deadbeef" * 4):
+        assert tracing.current_trace_id() == "deadbeef" * 4
+    with tracing.request_trace("r", trace_id="not-hex!"):
+        adopted = tracing.current_trace_id()
+        assert adopted != "not-hex!" and tracing.valid_trace_id(adopted)
+
+
+def test_span_budget_caps_tree_growth():
+    tracing.configure(recent=4, slowest=4, max_spans=3)
+    with tracing.request_trace("budget"):
+        for _ in range(10):
+            with tracing.span("leaf"):
+                pass
+    t = tracing.traces()["recent"][-1]
+    assert len(t.get("spans", ())) == 3
+    # the overflow spans still fed the flat aggregates
+    assert metrics.stats()["leaf"]["count"] == 10
+    tracing.configure()  # defaults back
+
+
+def test_cross_task_attribution():
+    async def main():
+        with tracing.request_trace("xtask"):
+            captured = tracing.current_span()
+
+        # drainer-style attribution happens after the submitter's
+        # context is gone — but before the root is recorded it works:
+        with tracing.request_trace("xtask2"):
+            parent = tracing.current_span()
+            child = tracing.child_span(parent, "queue_wait")
+            await asyncio.sleep(0)
+            tracing.finish_child(child, batch=4)
+            with tracing.attached(parent), tracing.span("journal"):
+                pass
+        t = tracing.traces()["recent"][-1]
+        names = _span_names(t)
+        assert "queue_wait" in names and "journal" in names
+        # late children of a recorded trace are refused
+        assert tracing.child_span(captured, "late") is None
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------------- metric bounds ----
+
+def test_cardinality_cap_drops_and_counts():
+    metrics.set_max_names(4)
+    try:
+        for i in range(10):
+            metrics.inc(f"dyn.counter.{i}")
+        counts = metrics.counters()
+        named = [k for k in counts if k.startswith("dyn.counter.")]
+        assert len(named) == 4
+        assert counts[metrics.DROPPED] == 6
+        # the drop counter itself is exempt from the cap
+        metrics.inc(metrics.DROPPED, 0)
+        assert metrics.DROPPED in metrics.counters()
+        # histograms have their own cap
+        for i in range(10):
+            metrics.observe(f"dyn.hist.{i}", 1.0)
+        hists = metrics.histograms()
+        assert len([k for k in hists if k.startswith("dyn.hist.")]) == 4
+    finally:
+        metrics.set_max_names(1024)
+
+
+def test_histogram_shape_and_buckets():
+    metrics.observe("h", 0.3, buckets=(0.1, 0.5, 1.0))
+    metrics.observe("h", 0.05)
+    metrics.observe("h", 99.0)
+    h = metrics.histograms()["h"]
+    assert h["bounds"] == (0.1, 0.5, 1.0)
+    assert h["counts"] == [1, 1, 0, 1]  # +Inf overflow last
+    assert h["count"] == 3
+
+
+# ------------------------------------------------------------ events ----
+
+def test_events_ring_and_filter():
+    events.configure(maxlen=3)
+    try:
+        for i in range(5):
+            events.emit("tick", i=i)
+        events.emit("tock")
+        snap = events.snapshot()
+        assert len(snap) == 3
+        assert snap[-1]["kind"] == "tock"
+        assert events.snapshot(kind="tick")[-1]["i"] == 4
+        assert len(events.snapshot(limit=1)) == 1
+        # trace_id is stamped when emitted inside a trace
+        with tracing.request_trace("ev", trace_id="cafe" * 8):
+            events.emit("traced")
+        assert events.snapshot(kind="traced")[-1]["trace_id"] == "cafe" * 8
+        assert snap[0]["trace_id"] is None
+    finally:
+        events.configure(maxlen=256)
+
+
+# ------------------------------------------------------ jsonl logging ----
+
+def test_jsonl_formatter_includes_trace_id():
+    fmt = JsonlFormatter()
+    rec = logging.LogRecord("upow.test", logging.INFO, __file__, 1,
+                            "hello %s", ("world",), None)
+    with tracing.request_trace("fmt", trace_id="beef" * 8):
+        line = fmt.format(rec)
+    d = json.loads(line)
+    assert d["msg"] == "hello world"
+    assert d["trace_id"] == "beef" * 8
+    assert d["level"] == "INFO" and d["logger"] == "upow.test"
+    # outside any trace the field is null, and exceptions serialize
+    try:
+        raise ValueError("boom")
+    except ValueError:
+        import sys
+        rec = logging.LogRecord("upow.test", logging.ERROR, __file__, 1,
+                                "bad", (), sys.exc_info())
+    d = json.loads(fmt.format(rec))
+    assert d["trace_id"] is None
+    assert "boom" in d["exc"]

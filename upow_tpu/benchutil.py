@@ -28,13 +28,18 @@ def boxed_call(fn, timeout: float):
     PJRT client can neither be interrupted nor joined — the daemon
     thread is abandoned and the caller decides what degraded mode means.
     """
+    import contextvars
     import threading
 
     box: dict = {}
+    # carry the caller's contextvars into the worker so telemetry
+    # emitted inside the boxed call (fault events, spans) keeps the
+    # caller's trace ID — a bare Thread starts with an empty context
+    ctx = contextvars.copy_context()
 
     def run():
         try:
-            box["ok"] = fn()
+            box["ok"] = ctx.run(fn)
         except Exception as e:
             box["err"] = e
 

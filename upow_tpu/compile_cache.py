@@ -97,22 +97,41 @@ def evict_host_dir(cache_root: str) -> None:
                   ignore_errors=True)
 
 
+_enabled_dir = ""  # set by enable(); read by entry_count() for /metrics
+
+
 def enable(cache_root: str) -> str:
     """Point JAX's persistent compile cache at a per-host subdir of
     ``cache_root``.  Never raises; returns the directory used ('' on
     failure)."""
     import jax
 
+    global _enabled_dir
     path = os.path.join(cache_root, host_fingerprint())
     try:
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+        _enabled_dir = path
         return path
     except Exception as e:
         logging.getLogger("upow_tpu.compile_cache").warning(
             "could not enable persistent compile cache at %s: %s", path, e)
         return ""
+
+
+def entry_count() -> int:
+    """Entries in the enabled persistent cache dir (-1 when disabled).
+
+    Operational gauge only — complements the in-process jit hit/miss
+    counters in telemetry.device, which cover the (far hotter) traced-
+    program reuse inside one process lifetime."""
+    if not _enabled_dir:
+        return -1
+    try:
+        return len(os.listdir(_enabled_dir))
+    except OSError:
+        return 0
 
 
 # --- cpu_aot_loader warning triage ---------------------------------------

@@ -212,6 +212,21 @@ class LogConfig:
     max_bytes: int = 5 * 1024 * 1024   # my_logger.py rotation size
     backups: int = 100
     console: bool = True
+    json_format: bool = False       # JSONL records carrying trace_id
+
+
+@dataclass
+class TelemetryConfig:
+    """Observability knobs (upow_tpu/telemetry/) — operational only,
+    never consensus.  All overridable as ``UPOW_TELEMETRY_<FIELD>``."""
+
+    trace_requests: bool = True     # root span per inbound HTTP request
+    trace_recent: int = 32          # completed traces kept, recency ring
+    trace_slowest: int = 16         # completed traces kept, slowest top-N
+    max_trace_spans: int = 512      # span budget per trace tree
+    events_buffer: int = 256        # /debug/events ring size
+    max_metric_names: int = 1024    # cardinality cap per registry kind
+    debug_endpoints: bool = True    # serve /debug/traces, /debug/events
 
 
 @dataclass
@@ -223,6 +238,7 @@ class Config:
     log: LogConfig = field(default_factory=LogConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
     @classmethod
     def load(cls, path: Optional[str] = None, **overrides) -> "Config":
@@ -263,7 +279,7 @@ def _merge_dict(cfg: Config, data: dict) -> Config:
 
 def _merge_env(cfg: Config) -> Config:
     for section in ("device", "node", "ws", "miner", "log", "resilience",
-                    "mempool"):
+                    "mempool", "telemetry"):
         sub = getattr(cfg, section)
         for f in dataclasses.fields(sub):
             env = f"UPOW_{section.upper()}_{f.name.upper()}"

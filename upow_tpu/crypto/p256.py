@@ -1416,6 +1416,17 @@ def verify_batch_prehashed(
         # the limb-list kernel reshapes the batch axis to (rows, 128)
         pad_block = max(pad_block, 128)
 
+    # occupancy + in-process jit hit/miss telemetry: real lanes vs the
+    # padded batch actually dispatched; the compile key mirrors what
+    # jit retraces on (padded shape + static kernel choices)
+    from ..telemetry import device as _ktel
+
+    _ktel.record_batch(
+        "p256_verify", real=n, padded=_pad_to_block(n, pad_block),
+        compile_key=(backend, scalar_prep, _pad_to_block(n, pad_block),
+                     PALLAS_KERNEL,
+                     mesh.devices.size if mesh is not None else 0))
+
     if scalar_prep == "device":
         padded = _pad_to_block(n, pad_block)
         inputs, zs, rs, ss, qxs, qys = _pack_device_inputs(

@@ -26,6 +26,7 @@ masked u32 compares plus a nibble bound, precomputed by :func:`target_spec`.
 from __future__ import annotations
 
 import functools
+import time
 from typing import NamedTuple, Sequence, Tuple
 
 import jax
@@ -530,6 +531,8 @@ def sha256_batch_jnp(messages: Sequence[bytes]) -> list:
     call.  Used for on-device txid batches (manager.py:365-378 hashes every
     tx); odd stragglers cost one extra bucket, not a recompile per length.
     """
+    from ..telemetry import device as _ktel
+
     out: list = [None] * len(messages)
     buckets: dict = {}
     for idx, m in enumerate(messages):
@@ -542,7 +545,16 @@ def sha256_batch_jnp(messages: Sequence[bytes]) -> list:
             padded = (m + b"\x80" + b"\x00" * ((55 - len(m)) % 64)
                       + (8 * len(m)).to_bytes(8, "big"))
             rows[r] = np.frombuffer(padded, dtype=">u4").astype(np.uint32)
+        # occupancy for this kernel = message bytes vs dispatched block
+        # bytes (sha padding waste); jit retraces per (rows, n_blocks)
+        t0 = time.perf_counter()
         digests = np.asarray(_sha256_blocks_jnp(jnp.asarray(rows), n_blocks))
+        _ktel.record_batch(
+            "sha256_txid",
+            real=sum(len(messages[idx]) for idx in idxs),
+            padded=len(idxs) * n_blocks * 64,
+            seconds=time.perf_counter() - t0,
+            compile_key=(len(idxs), n_blocks))
         for r, idx in enumerate(idxs):
             out[idx] = b"".join(int(x).to_bytes(4, "big") for x in digests[r])
     return out

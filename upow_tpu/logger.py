@@ -8,6 +8,8 @@ a WARNING level.
 
 from __future__ import annotations
 
+import datetime
+import json
 import logging
 import logging.handlers
 import os
@@ -18,6 +20,27 @@ from .config import LogConfig
 _configured = False
 
 
+class JsonlFormatter(logging.Formatter):
+    """One JSON object per line, carrying the active trace ID so log
+    lines join against /debug/traces and cross-node gossip hops."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        # late import: telemetry.metrics itself logs through this module
+        from .telemetry import tracing
+
+        rec = {
+            "ts": datetime.datetime.fromtimestamp(
+                record.created, tz=datetime.timezone.utc).isoformat(),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+            "trace_id": tracing.current_trace_id(),
+        }
+        if record.exc_info:
+            rec["exc"] = self.formatException(record.exc_info)
+        return json.dumps(rec, default=str)
+
+
 def setup_logging(cfg: Optional[LogConfig] = None) -> logging.Logger:
     """Idempotent: first caller wins, later calls return the root logger."""
     global _configured
@@ -26,8 +49,11 @@ def setup_logging(cfg: Optional[LogConfig] = None) -> logging.Logger:
         return root
     cfg = cfg or LogConfig()
     root.setLevel(getattr(logging, cfg.level.upper(), logging.INFO))
-    fmt = logging.Formatter(
-        "%(asctime)s %(levelname)s [%(name)s] %(message)s")
+    if getattr(cfg, "json_format", False):
+        fmt: logging.Formatter = JsonlFormatter()
+    else:
+        fmt = logging.Formatter(
+            "%(asctime)s %(levelname)s [%(name)s] %(message)s")
     if cfg.path:
         os.makedirs(os.path.dirname(cfg.path) or ".", exist_ok=True)
         fh = logging.handlers.RotatingFileHandler(

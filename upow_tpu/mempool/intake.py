@@ -54,7 +54,7 @@ def _reject(error: str) -> dict:
 
 class _Req:
     __slots__ = ("tx", "sender", "fut", "t0", "tx_hash", "first_address",
-                 "checks", "slice", "dup_of", "result")
+                 "checks", "slice", "dup_of", "result", "span", "wait_span")
 
     def __init__(self, tx, sender, fut):
         self.tx = tx
@@ -67,6 +67,11 @@ class _Req:
         self.slice = (0, 0)
         self.dup_of: Optional["_Req"] = None
         self.result: Optional[dict] = None
+        # trace attribution across the submit -> drainer task hop: the
+        # drainer records its per-request work against the submitting
+        # request's span (telemetry/tracing.py cross-task API)
+        self.span = trace.current_span()
+        self.wait_span = trace.child_span(self.span, "intake.queue_wait")
 
 
 class IntakeCoordinator:
@@ -159,7 +164,11 @@ class IntakeCoordinator:
         inj = get_injector()
         if inj is not None:
             try:
-                await inj.fire("mempool.intake", key=str(len(batch)))
+                # attribute the batch-level fault to the first
+                # submitter's trace so /debug/events can tie it back to
+                # a request (the drainer itself has no ambient trace)
+                with trace.attached(batch[0].span if batch else None):
+                    await inj.fire("mempool.intake", key=str(len(batch)))
             except FaultInjected:
                 trace.inc("mempool.intake_faults")
                 for req in batch:
@@ -178,6 +187,7 @@ class IntakeCoordinator:
         seen: Dict[str, _Req] = {}
         survivors: List[_Req] = []
         for req in batch:
+            trace.finish_child(req.wait_span, batch=len(batch))
             tx = req.tx
             if getattr(tx, "is_coinbase", False) or any(
                     i.signature is None for i in tx.inputs):
@@ -228,6 +238,7 @@ class IntakeCoordinator:
         verdicts: List[bool] = []
         if flat:
             dev = node.config.device
+            t_dispatch = time.perf_counter()
             try:
                 with trace.span("mempool.sig_dispatch", n=len(flat)):
                     verdicts = await txverify.run_sig_checks_async(
@@ -240,6 +251,14 @@ class IntakeCoordinator:
                 for req in survivors:
                     self._resolve(req, _reject(ERR_NOT_ADDED))
                 survivors = []
+            # the ONE coalesced dispatch appears in EVERY sharing
+            # request's trace tree (same wall interval, n/coalesced
+            # fields show the sharing)
+            t_done = time.perf_counter()
+            for req in survivors:
+                trace.add_span(req.span, "intake.sig_dispatch",
+                               t_dispatch, t_done, n=len(flat),
+                               coalesced=len(survivors))
 
         # -- phase C: finalize in batch order ------------------------------
         claimed: Dict[tuple, str] = {}  # intra-batch outpoint claims
@@ -257,7 +276,10 @@ class IntakeCoordinator:
                 self._resolve(req, _reject(ERR_NOT_ADDED))
                 continue
             try:
-                last_seq = await node.state.add_pending_transaction(req.tx)
+                with trace.attached(req.span), \
+                        trace.span("push_tx.journal_write"):
+                    last_seq = await node.state.add_pending_transaction(
+                        req.tx)
                 added += 1
             except Exception as e:  # serial parity (journal reject)
                 log.info("tx rejected %s: %s", req.tx_hash, e)
@@ -269,8 +291,13 @@ class IntakeCoordinator:
                 tx_hash=req.tx_hash, tx_hex=req.tx.hex(),
                 fees=await node.state.tx_fees(req.tx),
                 outpoints=outpoints, tx=req.tx))
-            await node.accept_tx_effects(req.tx, req.tx_hash,
-                                         req.first_address, req.sender)
+            # attached(): the ws broadcast / gossip tasks spawned inside
+            # inherit THIS request's trace context, so the outbound
+            # X-Upow-Trace header carries the submitter's ID (asserted
+            # end-to-end by tests/test_telemetry.py)
+            with trace.attached(req.span), trace.span("push_tx.effects"):
+                await node.accept_tx_effects(req.tx, req.tx_hash,
+                                             req.first_address, req.sender)
             self._resolve(req, {"ok": True, "result": MSG_ACCEPTED,
                                 "tx_hash": req.tx_hash})
 

@@ -26,7 +26,7 @@ from typing import List, Optional
 
 from aiohttp import web
 
-from .. import trace
+from .. import telemetry, trace
 from ..config import Config
 from ..core.constants import (ENDIAN, MAX_BLOCK_SIZE_HEX, MAX_SUPPLY,
                               SMALLEST, VERSION)
@@ -89,6 +89,7 @@ class Node:
     def __init__(self, config: Optional[Config] = None, state=None):
         self.config = config or Config()
         setup_logging(self.config.log)
+        telemetry.configure(self.config.telemetry)
         self.config.device.apply_kernel_overrides()
         if state is not None:
             # injected backend (tests: the pg backend over the mock
@@ -351,8 +352,24 @@ class Node:
                 self.self_url = f"{request.scheme}://{request.host}"
             self._spawn(self._bootstrap())
 
+        # request-scoped trace root: inbound gossip hops adopt the
+        # peer's X-Upow-Trace ID so one push_tx/push_block is one trace
+        # across nodes; scrape/debug/ws endpoints stay untraced (they
+        # would drown the recency ring)
+        traced = self.config.telemetry.trace_requests and not (
+            normalized in ("/metrics", "/ws")
+            or normalized.startswith("/debug"))
+        trace_id = None
         try:
-            response = await handler(request)
+            if traced:
+                with telemetry.request_trace(
+                        "http." + (normalized.strip("/") or "root"),
+                        trace_id=request.headers.get(telemetry.TRACE_HEADER),
+                        ) as troot:
+                    trace_id = troot.trace_id
+                    response = await handler(request)
+            else:
+                response = await handler(request)
         except web.HTTPException:
             raise
         except _BadParam as e:
@@ -366,6 +383,8 @@ class Node:
                 {"ok": False, "error": f"Uncaught {type(e).__name__} exception"},
                 status=500)
         response.headers["Access-Control-Allow-Origin"] = "*"
+        if trace_id is not None:
+            response.headers[telemetry.TRACE_HEADER] = trace_id
         self._spawn(self._propagate_old_transactions())
         return response
 
@@ -456,19 +475,22 @@ class Node:
         # Without this, any parseable garbage enters the mempool and gets
         # handed to miners, whose blocks then fail acceptance.
         try:
-            ok = await self.make_tx_verifier().verify_pending(
-                tx, sig_backend=self.config.device.sig_backend)
+            with telemetry.span("push_tx.verify"):
+                ok = await self.make_tx_verifier().verify_pending(
+                    tx, sig_backend=self.config.device.sig_backend)
         except Exception as e:
             log.info("tx verify error %s: %s", tx_hash, e)
             ok = False
         if not ok:
             return {"ok": False, "error": "Transaction has not been added"}
         try:
-            await self.state.add_pending_transaction(tx)
+            with telemetry.span("push_tx.journal_write"):
+                await self.state.add_pending_transaction(tx)
         except Exception as e:
             log.info("tx rejected %s: %s", tx_hash, e)
             return {"ok": False, "error": "Transaction has not been added"}
-        await self.accept_tx_effects(tx, tx_hash, first_address, sender)
+        with telemetry.span("push_tx.effects"):
+            await self.accept_tx_effects(tx, tx_hash, first_address, sender)
         return {"ok": True, "result": "Transaction has been accepted",
                 "tx_hash": tx_hash}
 
@@ -532,89 +554,96 @@ class Node:
         (SURVEY §5 notes the reference has "No Prometheus/StatsD").
         Gauges for chain/mempool/peer/WS state plus the span registry as
         per-section count/total/max series, resilience event counters
-        (``upow_<name>_total``), per-state breaker counts, and the
-        device-verify health gauge."""
-        lines = []
-
-        def gauge(name, value, help_text):
-            lines.append(f"# HELP {name} {help_text}")
-            lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {value}")
-
-        gauge("upow_block_height", await self.state.get_next_block_id() - 1,
-              "Height of the last accepted block")
-        gauge("upow_mempool_transactions",
-              await self.state.get_pending_transactions_count(),
-              "Transactions waiting in the mempool")
-        if self.config.mempool.enabled:
-            gauge("upow_mempool_pool_depth", len(self.pool),
-                  "Transactions in the in-memory fee-priority pool")
-            gauge("upow_mempool_pool_bytes_hex", self.pool.total_bytes_hex,
-                  "Total hex chars held by the in-memory pool")
-            for key, help_text in (
-                    ("hits", "Mining-info requests served from the"
-                             " generation-keyed cache"),
-                    ("misses", "Mining-info requests that rebuilt the"
-                               " template")):
-                name = f"upow_mining_info_cache_{key}_total"
-                lines.append(f"# HELP {name} {help_text}")
-                lines.append(f"# TYPE {name} counter")
-                lines.append(f"{name} {getattr(self.mining_cache, key)}")
-        gauge("upow_peers_known", len(self.peers.all_nodes()),
-              "Peers in the peer book")
-        gauge("upow_peers_active", len(self.peers.recent_nodes()),
-              "Peers messaged within the activity window")
-        gauge("upow_node_syncing", int(bool(self.is_syncing)),
-              "1 while a chain sync is in progress")
+        (``upow_<name>_total``), per-state breaker counts, kernel
+        occupancy/compile telemetry, and the device-verify health gauge.
+        Rendering and name sanitization live in telemetry/exposition.py;
+        the format is pinned by tests/test_telemetry.py's validator."""
+        from ..compile_cache import entry_count
         from ..verify.txverify import sig_verdict_stats
 
+        e = telemetry.exposition.Exposition()
+        e.gauge("block_height", await self.state.get_next_block_id() - 1,
+                "Height of the last accepted block")
+        e.gauge("mempool_transactions",
+                await self.state.get_pending_transactions_count(),
+                "Transactions waiting in the mempool")
+        last_block = await self.state.get_last_block()
+        lag = max(0, timestamp() - last_block["timestamp"]) \
+            if last_block else 0
+        e.gauge("sync_lag_seconds", lag,
+                "Seconds since the tip block's consensus timestamp")
+        if self.config.mempool.enabled:
+            e.gauge("mempool_pool_depth", len(self.pool),
+                    "Transactions in the in-memory fee-priority pool")
+            e.gauge("mempool_pool_bytes_hex", self.pool.total_bytes_hex,
+                    "Total hex chars held by the in-memory pool")
+            e.counter("mining_info_cache_hits", self.mining_cache.hits,
+                      "Mining-info requests served from the"
+                      " generation-keyed cache")
+            e.counter("mining_info_cache_misses", self.mining_cache.misses,
+                      "Mining-info requests that rebuilt the template")
+        e.gauge("peers_known", len(self.peers.all_nodes()),
+                "Peers in the peer book")
+        e.gauge("peers_active", len(self.peers.recent_nodes()),
+                "Peers messaged within the activity window")
+        e.gauge("node_syncing", int(bool(self.is_syncing)),
+                "1 while a chain sync is in progress")
         sig = sig_verdict_stats()
-        gauge("upow_sig_cache_entries", sig["size"],
-              "Entries in the signature-verdict cache")
-        for key, help_text in (
-                ("hits", "Signature checks answered from the verdict cache"),
-                ("misses", "Signature checks that required verification")):
-            name = f"upow_sig_cache_{key}_total"
-            lines.append(f"# HELP {name} {help_text}")
-            lines.append(f"# TYPE {name} counter")
-            lines.append(f"{name} {sig[key]}")
+        e.gauge("sig_cache_entries", sig["size"],
+                "Entries in the signature-verdict cache")
+        e.counter("sig_cache_hits", sig["hits"],
+                  "Signature checks answered from the verdict cache")
+        e.counter("sig_cache_misses", sig["misses"],
+                  "Signature checks that required verification")
         if self.ws_hub is not None:
             ws = self.ws_hub.get_stats()
-            gauge("upow_ws_connections", ws["total_connections"],
-                  "Open WebSocket push connections")
-            gauge("upow_ws_messages_out", ws["messages_out"],
-                  "WebSocket messages delivered")
+            e.gauge("ws_connections", ws["total_connections"],
+                    "Open WebSocket push connections")
+            e.gauge("ws_messages_out", ws["messages_out"],
+                    "WebSocket messages delivered")
         for state_name, count in sorted(self.breakers.state_counts().items()):
-            gauge(f"upow_breaker_{state_name}_peers", count,
-                  f"Peers whose circuit breaker is {state_name}")
-        gauge("upow_device_verify_health",
-              self.manager.device_health()["gauge"],
-              "Device verify path: 0=ok 1=degraded(CPU) 2=poisoned")
+            e.gauge(f"breaker_{state_name}_peers", count,
+                    f"Peers whose circuit breaker is {state_name}")
+        e.gauge("device_verify_health",
+                self.manager.device_health()["gauge"],
+                "Device verify path: 0=ok 1=degraded(CPU) 2=poisoned")
+        cache_entries = entry_count()
+        if cache_entries >= 0:
+            e.gauge("compile_cache_persistent_entries", cache_entries,
+                    "Entries in the persistent jit compile cache")
+        for label, mem in sorted(telemetry.device.device_memory().items()):
+            for key, value in sorted(mem.items()):
+                e.gauge(f"device_{label}_{key}", value,
+                        "Best-effort device memory_stats() value")
         for name, value in sorted(trace.counters().items()):
-            safe = name.replace(".", "_").replace("-", "_")
-            lines.append(f"# TYPE upow_{safe}_total counter")
-            lines.append(f"upow_{safe}_total {value}")
+            e.counter(name, value)
         for name, s in sorted(trace.stats().items()):
-            safe = name.replace(".", "_").replace("-", "_")
-            lines.append(f"# TYPE upow_span_{safe}_count counter")
-            lines.append(f"upow_span_{safe}_count {s['count']}")
-            lines.append(f"# TYPE upow_span_{safe}_seconds_total counter")
-            lines.append(f"upow_span_{safe}_seconds_total {s['total_s']:.6f}")
-            lines.append(f"# TYPE upow_span_{safe}_seconds_max gauge")
-            lines.append(f"upow_span_{safe}_seconds_max {s['max_s']:.6f}")
+            e.span_stats(name, s)
         for name, h in sorted(trace.histograms().items()):
-            safe = name.replace(".", "_").replace("-", "_")
-            lines.append(f"# TYPE upow_{safe} histogram")
-            cum = 0
-            for bound, count in zip(h["bounds"], h["counts"]):
-                cum += count
-                lines.append(f'upow_{safe}_bucket{{le="{bound}"}} {cum}')
-            cum += h["counts"][-1]
-            lines.append(f'upow_{safe}_bucket{{le="+Inf"}} {cum}')
-            lines.append(f"upow_{safe}_sum {h['sum']:.6f}")
-            lines.append(f"upow_{safe}_count {h['count']}")
-        return web.Response(text="\n".join(lines) + "\n",
-                            content_type="text/plain")
+            e.histogram(name, h["bounds"], h["counts"],
+                        h["count"], h["sum"])
+        resp = web.Response(text=e.render())
+        # full 0.0.4 content type (Prometheus requires the version
+        # parameter; aiohttp's ctor only takes the bare mime type)
+        resp.headers["Content-Type"] = telemetry.exposition.CONTENT_TYPE
+        return resp
+
+    async def h_debug_traces(self, request: web.Request) -> web.Response:
+        """Completed trace trees: recency ring + slowest top-N
+        (telemetry/tracing.py TraceBuffer)."""
+        return web.json_response({"ok": True,
+                                  "result": telemetry.traces()})
+
+    async def h_debug_events(self, request: web.Request) -> web.Response:
+        """Structured event ring: reorgs, breaker trips, degrade
+        transitions, fault injections — oldest first, each stamped with
+        the trace ID active when it fired."""
+        params = request.rel_url.query
+        limit = _int_q(params, "limit", 0) or None
+        kind = params.get("kind")
+        return web.json_response({
+            "ok": True,
+            "result": telemetry.events.snapshot(limit=limit, kind=kind)})
 
     async def h_push_tx(self, request: web.Request) -> web.Response:
         if self.is_syncing:
@@ -1487,6 +1516,9 @@ class Node:
             ("/metrics", self.h_metrics),
         ]:
             r.add_get(path, handler)
+        if self.config.telemetry.debug_endpoints:
+            r.add_get("/debug/traces", self.h_debug_traces)
+            r.add_get("/debug/events", self.h_debug_events)
         if self.config.ws.enabled:
             from ..ws.hub import WsHub
 

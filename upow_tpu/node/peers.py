@@ -256,7 +256,7 @@ class NodeInterface:
         query params for everything else (reference
         nodes_manager.py:192-209) — so e.g. gossiped ``add_node`` lands on
         peers' GET routes."""
-        headers = {"Sender-Node": sender_node} if sender_node else {}
+        headers = self._rpc_headers(sender_node)
 
         async def attempt() -> dict:
             session = await self._get_session()
@@ -271,9 +271,20 @@ class NodeInterface:
 
         return await self._resilient(attempt, path)
 
+    @staticmethod
+    def _rpc_headers(sender_node: str) -> dict:
+        """Common outbound headers: peer identity plus the current trace
+        ID, so a gossiped tx/block keeps one trace across nodes (the
+        receiving middleware adopts X-Upow-Trace)."""
+        headers = {"Sender-Node": sender_node} if sender_node else {}
+        tid = trace.current_trace_id()
+        if tid is not None:
+            headers[trace.TRACE_HEADER] = tid
+        return headers
+
     async def get(self, path: str, params: Optional[dict] = None,
                   sender_node: str = "") -> dict:
-        headers = {"Sender-Node": sender_node} if sender_node else {}
+        headers = self._rpc_headers(sender_node)
 
         async def attempt() -> dict:
             session = await self._get_session()

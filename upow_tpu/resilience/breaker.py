@@ -45,11 +45,13 @@ class CircuitBreaker:
 
     def __init__(self, failure_threshold: int = 5, open_secs: float = 30.0,
                  half_open_max: int = 1,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 key: str = ""):
         self.failure_threshold = failure_threshold
         self.open_secs = open_secs
         self.half_open_max = half_open_max
         self._clock = clock
+        self.key = key  # peer URL when registry-owned; "" standalone
         self._state = CLOSED
         self._consecutive_failures = 0
         self._opened_at = 0.0
@@ -69,8 +71,14 @@ class CircuitBreaker:
 
     def _set_state(self, state: str) -> None:
         if state != self._state:
+            previous = self._state
             self._state = state
             self.transitions.append(state)
+            from .. import trace
+
+            trace.event("breaker", peer=self.key or None, state=state,
+                        previous=previous,
+                        failures=self._consecutive_failures)
 
     def available(self) -> bool:
         """May a request be sent now?  Half-open admits up to
@@ -136,7 +144,8 @@ class BreakerRegistry:
         with self._lock:
             breaker = self._breakers.get(key)
             if breaker is None:
-                breaker = CircuitBreaker(clock=self._clock, **self._kw)
+                breaker = CircuitBreaker(clock=self._clock, key=key,
+                                         **self._kw)
                 self._breakers[key] = breaker
             return breaker
 

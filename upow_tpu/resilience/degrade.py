@@ -114,6 +114,7 @@ class DegradeManager:
             if self._state == DEGRADED:
                 self._state = OK
                 trace.inc("resilience.device_recovered")
+                trace.event("degrade", state=OK, previous=DEGRADED)
                 log.warning("device verify path recovered; leaving "
                             "CPU-degraded mode")
 
@@ -132,6 +133,9 @@ class DegradeManager:
                 self._state = DEGRADED
                 self._degraded_at = self._clock()
                 trace.inc("resilience.device_degraded")
+                trace.event("degrade", state=DEGRADED, previous=OK,
+                            failures=self._consecutive_failures,
+                            error=str(error) if error else None)
                 log.warning(
                     "device verify path degraded after %d consecutive "
                     "errors (%s); falling back to the CPU reference "
@@ -145,8 +149,11 @@ class DegradeManager:
 
         with self._lock:
             if self._state != POISONED:
+                prev = self._state
                 self._state = POISONED
                 trace.inc("resilience.device_poisoned")
+                trace.event("degrade", state=POISONED, previous=prev,
+                            reason=reason or None)
                 log.warning("device verify path poisoned%s; CPU path for "
                             "the rest of this process",
                             f" ({reason})" if reason else "")

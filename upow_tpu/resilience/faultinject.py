@@ -151,6 +151,8 @@ class FaultInjector:
         from .. import trace
 
         trace.inc("resilience.faults_injected")
+        trace.event("fault_injected", site=site, fault=fault.kind,
+                    key=key or None, fire=fault.fired)
         log.info("fault injected: %s at %s key=%s (fire #%d)",
                  fault.kind, site, key or "-", fault.fired)
 

@@ -430,6 +430,10 @@ class PgChainState(StateViews):
                 (from_block_id,))
             txs = [tx_from_hex(r["tx_hex"], check_signatures=False)
                    for r in rows]
+            from .. import trace
+
+            trace.event("reorg", from_block=from_block_id,
+                        removed_txs=len(txs))
             created = [tx.hash() for tx in txs]
             for table in ("unspent_outputs",) + _GOV_TABLES:
                 await self.drv.aexecutemany(

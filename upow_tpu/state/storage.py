@@ -427,6 +427,10 @@ class ChainState(StateViews):
             " WHERE b.id >= ?", (from_block_id,),
         ).fetchall()
         txs = [tx_from_hex(r["tx_hex"], check_signatures=False) for r in rows]
+        from .. import trace
+
+        trace.event("reorg", from_block=from_block_id,
+                    removed_txs=len(txs))
         # drop outputs created by removed txs (from whichever table)
         created = [tx.hash() for tx in txs]
         for table in ("unspent_outputs",) + _GOV_TABLES:

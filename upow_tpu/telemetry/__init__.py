@@ -1,0 +1,99 @@
+"""Telemetry subsystem: spans → trace trees, metrics, events, /metrics.
+
+Grown out of the original single-module ``trace.py`` (which remains as
+a thin compatibility shim re-exporting this package).  Layers:
+
+* :mod:`.metrics` — flat process-wide aggregates (span stats, counters,
+  fixed-bucket histograms) with a cardinality cap.
+* :mod:`.tracing` — contextvar-based request-scoped trace trees with a
+  bounded ring buffer (recent + slowest) and cross-node trace-ID
+  propagation via the ``X-Upow-Trace`` header.
+* :mod:`.events` — structured event ring buffer (reorgs, breaker
+  trips, degrade transitions, fault injections) for ``/debug/events``.
+* :mod:`.device` — TPU/kernel telemetry: batch occupancy, dispatch
+  latency, jit compile-cache hit/miss, device memory gauges.
+* :mod:`.exposition` — Prometheus 0.0.4 text rendering + the format
+  validator used by tests and ``make metrics-check``.
+
+The module-level functions below are the stable API every other
+subsystem imports (usually via the ``trace`` shim)."""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Optional
+
+from ..logger import get_logger
+from . import device, events, exposition, metrics, tracing
+from .events import emit as event
+from .metrics import (counters, ensure_counter, ensure_histogram,  # noqa: F401
+                      histograms, inc, observe, stats)
+from .tracing import (add_span, attached, child_span, current_span,  # noqa: F401
+                      current_trace_id, finish_child, new_trace_id,
+                      request_trace, span, traces, valid_trace_id)
+
+log = get_logger("telemetry")
+
+#: HTTP header carrying the trace ID across gossip hops.
+TRACE_HEADER = "X-Upow-Trace"
+
+__all__ = [
+    "TRACE_HEADER", "add_span", "attached", "child_span", "configure",
+    "counters", "current_span", "current_trace_id", "device",
+    "ensure_counter", "ensure_histogram", "event", "events",
+    "exposition", "finish_child", "histograms", "inc", "metrics",
+    "new_trace_id", "observe", "profile", "request_trace", "reset",
+    "span", "stats", "traces", "tracing", "valid_trace_id",
+]
+
+
+def configure(cfg=None) -> None:
+    """Apply a TelemetryConfig (config.py) and pre-register the metric
+    families the acceptance criteria require to exist from scrape #1
+    (occupancy / compile-cache series for the batch kernels)."""
+    if cfg is not None:
+        metrics.set_max_names(cfg.max_metric_names)
+        tracing.configure(recent=cfg.trace_recent,
+                          slowest=cfg.trace_slowest,
+                          max_spans=cfg.max_trace_spans)
+        events.configure(cfg.events_buffer)
+    device.preregister("p256_verify")
+    device.preregister("sha256_txid")
+
+
+def reset() -> None:
+    """Clear every registry and buffer (tests)."""
+    metrics.reset()
+    tracing.reset()
+    events.reset()
+    device.reset()
+
+
+@contextmanager
+def profile(trace_dir: Optional[str] = None):
+    """Capture a JAX profiler trace into ``trace_dir`` (xprof format).
+
+    No-op when trace_dir is falsy or the profiler is unavailable.  Only
+    profiler SETUP/TEARDOWN failures are swallowed — exceptions raised
+    by the caller's body must propagate untouched (a yield inside a
+    try/except would eat them and then crash contextlib)."""
+    if not trace_dir:
+        yield
+        return
+    ctx = None
+    try:
+        import jax
+
+        ctx = jax.profiler.trace(trace_dir)
+        ctx.__enter__()
+    except Exception as e:  # profiling must never break the caller
+        log.warning("jax profiler unavailable: %s", e)
+        ctx = None
+    try:
+        yield
+    finally:
+        if ctx is not None:
+            try:
+                ctx.__exit__(None, None, None)
+            except Exception as e:
+                log.warning("jax profiler teardown failed: %s", e)

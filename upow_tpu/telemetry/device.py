@@ -1,0 +1,101 @@
+"""TPU/kernel telemetry: dispatch latency, compile hit/miss, occupancy.
+
+The batch kernels (P-256 verify, sha256 txid) pad every batch up to a
+block multiple before dispatch; how much of each dispatched batch is
+*real* work was invisible until now.  ``record_batch`` feeds, per
+kernel:
+
+- ``kernel.<name>.dispatch_seconds``   latency histogram
+- ``kernel.<name>.occupancy``          real/padded-lane ratio histogram
+- ``kernel.<name>.lanes_real``         counters (padding waste =
+  ``kernel.<name>.lanes_padded``       padded - real)
+- ``kernel.<name>.compile_cache_hits`` jit in-process cache proxy:
+  ``kernel.<name>.compile_cache_misses``  the first dispatch of a
+  given compile key (padded shape / static args) compiles, later
+  ones reuse the traced program.
+
+Device memory gauges are best-effort: ``memory_stats()`` is populated
+on TPU/GPU backends and typically absent on CPU; we never import jax
+here — if the caller hasn't, there is nothing to report."""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Dict, Hashable, Optional, Set
+
+from ..logger import get_logger
+from . import metrics
+
+log = get_logger("telemetry")
+
+OCCUPANCY_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+DISPATCH_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
+
+_lock = threading.Lock()
+_seen_keys: Dict[str, Set[Hashable]] = {}
+_MAX_KEYS_PER_KERNEL = 4096
+
+
+def preregister(kernel: str) -> None:
+    """Create the kernel's metric families so /metrics exports them
+    (all-zero) before the first dispatch."""
+    metrics.ensure_histogram("kernel.%s.occupancy" % kernel,
+                             OCCUPANCY_BUCKETS)
+    metrics.ensure_histogram("kernel.%s.dispatch_seconds" % kernel,
+                             DISPATCH_BUCKETS)
+    for c in ("lanes_real", "lanes_padded",
+              "compile_cache_hits", "compile_cache_misses"):
+        metrics.ensure_counter("kernel.%s.%s" % (kernel, c))
+
+
+def record_batch(kernel: str, real: int, padded: int,
+                 seconds: Optional[float] = None,
+                 compile_key: Optional[Hashable] = None) -> None:
+    """Record one batch dispatch. ``real`` lanes of ``padded`` total."""
+    padded = max(int(padded), 1)
+    real = min(max(int(real), 0), padded)
+    metrics.inc("kernel.%s.lanes_real" % kernel, real)
+    metrics.inc("kernel.%s.lanes_padded" % kernel, padded)
+    metrics.observe("kernel.%s.occupancy" % kernel, real / padded,
+                    buckets=OCCUPANCY_BUCKETS)
+    if seconds is not None:
+        metrics.observe("kernel.%s.dispatch_seconds" % kernel, seconds,
+                        buckets=DISPATCH_BUCKETS)
+    if compile_key is not None:
+        with _lock:
+            seen = _seen_keys.setdefault(kernel, set())
+            hit = compile_key in seen
+            if not hit and len(seen) < _MAX_KEYS_PER_KERNEL:
+                seen.add(compile_key)
+        metrics.inc("kernel.%s.compile_cache_%s"
+                    % (kernel, "hits" if hit else "misses"))
+
+
+def device_memory() -> Dict[str, dict]:
+    """Best-effort per-device memory stats; {} when jax isn't loaded
+    or the backend doesn't expose memory_stats (CPU)."""
+    if "jax" not in sys.modules:
+        return {}
+    out: Dict[str, dict] = {}
+    try:
+        import jax
+        for dev in jax.local_devices():
+            try:
+                stats = dev.memory_stats()
+            except Exception as e:
+                log.debug("memory_stats failed for %s: %s", dev, e)
+                stats = None
+            if not stats:
+                continue
+            label = "%s_%d" % (dev.platform, dev.id)
+            out[label] = {k: v for k, v in stats.items()
+                          if isinstance(v, (int, float))}
+    except Exception as e:
+        log.debug("device memory stats unavailable: %s", e)
+    return out
+
+
+def reset() -> None:
+    with _lock:
+        _seen_keys.clear()

@@ -1,0 +1,53 @@
+"""Structured event ring buffer for the /debug/events surface.
+
+Rare-but-important state changes — reorgs, breaker trips, degrade
+transitions, fault injections — are worth keeping verbatim rather
+than only as counters: when a node misbehaves, the sequence and the
+trace IDs matter.  ``emit()`` stamps each record with wall-clock time
+and the current trace ID (None when emitted outside a traced
+context, e.g. from an executor thread)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, List, Optional
+
+from . import tracing
+
+_lock = threading.Lock()
+_events: deque = deque(maxlen=256)
+
+
+def configure(maxlen: int = 256) -> None:
+    global _events
+    with _lock:
+        _events = deque(_events, maxlen=max(1, int(maxlen)))
+
+
+def emit(kind: str, **fields: Any) -> None:
+    rec = {"ts": round(time.time(), 6), "kind": kind,
+           "trace_id": tracing.current_trace_id()}
+    for k, v in fields.items():
+        rec[k] = v if isinstance(v, (str, int, float, bool)) or v is None \
+            else str(v)
+    with _lock:
+        _events.append(rec)
+
+
+def snapshot(limit: Optional[int] = None,
+             kind: Optional[str] = None) -> List[dict]:
+    """Events oldest-first; optionally the last ``limit`` of one kind."""
+    with _lock:
+        out = list(_events)
+    if kind is not None:
+        out = [e for e in out if e["kind"] == kind]
+    if limit is not None:
+        out = out[-max(0, int(limit)):]
+    return out
+
+
+def reset() -> None:
+    with _lock:
+        _events.clear()

@@ -1,0 +1,164 @@
+"""Flat process-wide metric registries: spans, counters, histograms.
+
+This is the aggregation layer the old ``trace.py`` module provided,
+extracted so the tracing layer (trace trees) and the exposition layer
+(/metrics rendering) can grow around it without every consumer
+changing its import.  All registries are name -> aggregate dicts and
+are safe to update from executor threads (a single lock guards every
+mutation; reads snapshot under the same lock).
+
+Cardinality is bounded: at most ``max_names`` *distinct* names may
+exist per registry kind (span / counter / histogram).  A name beyond
+the cap is dropped with one warning per kind — a bug that derives
+metric names from request data cannot grow the registries without
+limit under heavy traffic.  ``telemetry.dropped_names`` counts the
+drops (that counter itself is exempt from the cap).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+from ..logger import get_logger
+
+log = get_logger("telemetry")
+
+# Default buckets suit sub-second latencies; size-like metrics (batch
+# sizes, queue depths) pass their own buckets on first observe.
+_DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+#: counter tracking names dropped by the cardinality cap; exempt from
+#: the cap itself so the signal survives the overflow it reports.
+DROPPED = "telemetry.dropped_names"
+
+_lock = threading.Lock()
+_stats: Dict[str, dict] = {}
+_counters: Dict[str, int] = {}
+_hists: Dict[str, dict] = {}
+_max_names = 1024
+_warned: set = set()
+
+
+def set_max_names(n: int) -> None:
+    global _max_names
+    _max_names = max(1, int(n))
+
+
+def _admit(registry: dict, name: str, kind: str) -> bool:
+    """True if ``name`` may create a new entry in ``registry``."""
+    if name in registry or name == DROPPED:
+        return True
+    if len(registry) < _max_names:
+        return True
+    _counters[DROPPED] = _counters.get(DROPPED, 0) + 1
+    if kind not in _warned:
+        _warned.add(kind)
+        log.warning(
+            "metric cardinality cap (%d) reached for %s registry; "
+            "dropping new name %r (and any further new names)",
+            _max_names, kind, name)
+    return False
+
+
+# ------------------------------------------------------------- spans ---
+
+def record_span(name: str, seconds: float) -> None:
+    with _lock:
+        if not _admit(_stats, name, "span"):
+            return
+        agg = _stats.setdefault(name, {"count": 0, "total_s": 0.0,
+                                       "max_s": 0.0})
+        agg["count"] += 1
+        agg["total_s"] += seconds
+        agg["max_s"] = max(agg["max_s"], seconds)
+
+
+def stats() -> Dict[str, dict]:
+    with _lock:
+        return {k: dict(v) for k, v in _stats.items()}
+
+
+# ---------------------------------------------------------- counters ---
+
+def inc(name: str, n: int = 1) -> None:
+    with _lock:
+        if not _admit(_counters, name, "counter"):
+            return
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def counters() -> Dict[str, int]:
+    with _lock:
+        return dict(_counters)
+
+
+# -------------------------------------------------------- histograms ---
+
+def observe(name: str, value: float,
+            buckets: Optional[Sequence[float]] = None) -> None:
+    """Record ``value`` into histogram ``name``.
+
+    Bucket bounds are fixed by the first observe (or an earlier
+    ``ensure_histogram``); later ``buckets=`` arguments are ignored.
+    ``counts`` is per-bucket with the +Inf overflow LAST — not
+    cumulative; the exposition layer accumulates into Prometheus
+    ``le`` semantics.
+    """
+    with _lock:
+        h = _hists.get(name)
+        if h is None:
+            if not _admit(_hists, name, "histogram"):
+                return
+            h = _new_hist(name, buckets)
+        h["count"] += 1
+        h["sum"] += value
+        for i, bound in enumerate(h["bounds"]):
+            if value <= bound:
+                h["counts"][i] += 1
+                break
+        else:
+            h["counts"][-1] += 1  # +Inf overflow bucket
+
+
+def ensure_histogram(name: str,
+                     buckets: Optional[Sequence[float]] = None) -> None:
+    """Register an empty histogram so it is exported before first use."""
+    with _lock:
+        if name not in _hists and _admit(_hists, name, "histogram"):
+            _new_hist(name, buckets)
+
+
+def ensure_counter(name: str) -> None:
+    with _lock:
+        if name not in _counters and _admit(_counters, name, "counter"):
+            _counters[name] = 0
+
+
+def _new_hist(name: str, buckets: Optional[Sequence[float]]) -> dict:
+    bounds = tuple(sorted(buckets)) if buckets else _DEFAULT_BUCKETS
+    h = {"bounds": bounds, "counts": [0] * (len(bounds) + 1),
+         "count": 0, "sum": 0.0}
+    _hists[name] = h
+    return h
+
+
+def histograms() -> Dict[str, dict]:
+    """Snapshot: {name: {bounds, counts (per-bucket, +Inf last), sum,
+    count}} — the shape the original trace.py exported."""
+    with _lock:
+        return {k: {"bounds": v["bounds"], "counts": list(v["counts"]),
+                    "count": v["count"], "sum": v["sum"]}
+                for k, v in _hists.items()}
+
+
+# ------------------------------------------------------------- reset ---
+
+def reset() -> None:
+    """Clear every registry (tests)."""
+    with _lock:
+        _stats.clear()
+        _counters.clear()
+        _hists.clear()
+        _warned.clear()

@@ -1,0 +1,85 @@
+"""``make metrics-check``: boot the node app in-process, scrape
+``/metrics``, and run the exposition-format validator.
+
+This is the CI gate for the observability surface: it fails when any
+exported name is illegal, any histogram's cumulative buckets regress,
+the content type drifts from 0.0.4, a required metric family
+disappears, or a /debug endpoint stops returning well-formed JSON.
+Runs against an in-memory sqlite chain with networking disabled — no
+sockets, no peers, exactly like the test-suite clusters.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import tempfile
+
+from . import exposition
+
+#: families the acceptance criteria pin: kernel occupancy + compile
+#: cache, chain height, mempool depth (substring match on /metrics)
+REQUIRED = (
+    "upow_kernel_p256_verify_occupancy_bucket",
+    "upow_kernel_sha256_txid_occupancy_bucket",
+    "upow_kernel_p256_verify_compile_cache_hits_total",
+    "upow_kernel_p256_verify_compile_cache_misses_total",
+    "upow_block_height",
+    "upow_mempool_transactions",
+)
+
+
+async def _run() -> int:
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ..config import Config
+    from ..node.app import Node
+
+    scratch = tempfile.mkdtemp(prefix="upow-metrics-check-")
+    cfg = Config.load(
+        node__db_path="",                 # in-memory chain
+        node__seed_url="",                # no external seed
+        node__peers_file=f"{scratch}/nodes.json",
+        node__ip_config_file="",
+        ws__enabled=False,
+        device__sig_backend="host",
+        log__console=False, log__path="")
+    node = Node(cfg)
+    server = TestServer(node.app)
+    client = TestClient(server)
+    await client.start_server()
+    failures = []
+    try:
+        resp = await client.get("/metrics")
+        body = await resp.text()
+        ctype = resp.headers.get("Content-Type", "")
+        if ctype != exposition.CONTENT_TYPE:
+            failures.append(
+                f"content type {ctype!r} != {exposition.CONTENT_TYPE!r}")
+        failures.extend(exposition.validate(body))
+        for name in REQUIRED:
+            if name not in body:
+                failures.append(f"required metric missing: {name}")
+        for path in ("/debug/traces", "/debug/events"):
+            dresp = await client.get(path)
+            payload = await dresp.json()
+            if dresp.status != 200 or not payload.get("ok"):
+                failures.append(f"{path} unhealthy: {payload}")
+    finally:
+        await client.close()
+        await node.close()
+    if failures:
+        for f in failures:
+            print(f"metrics-check: FAIL {f}")
+        return 1
+    print(f"metrics-check: OK ({len(body.splitlines())} exposition lines,"
+          f" {len(REQUIRED)} required families present)")
+    return 0
+
+
+def main() -> int:
+    return asyncio.run(_run())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

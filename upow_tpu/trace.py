@@ -1,149 +1,20 @@
-"""Timing spans + JAX profiler hooks (SURVEY.md §5 tracing gap).
+"""Compatibility shim — the telemetry subsystem absorbed this module.
 
-The reference's only instrumentation is ad-hoc ``perf_counter`` prints
-around block creation (manager.py:655, 732-736) and UTXO deletes
-(database.py:628-663).  Here one tiny module serves both roles:
-
-* :func:`span` — context manager that logs the wall time of a named
-  section and feeds a process-wide stats registry (count / total /
-  max), exposed via :func:`stats` on the node's ``GET /`` health probe
-  (additive ``timings`` key).
-* :func:`profile` — wraps ``jax.profiler.trace`` so a kernel section
-  can be captured for xprof/tensorboard when a trace dir is configured;
-  a no-op otherwise (profiling must never take the node down).
-* :func:`inc` / :func:`counters` — process-wide event counters (retries,
-  breaker trips, device degradations, injected faults) exported on
-  ``/metrics`` as ``upow_<name>_total`` and asserted by the chaos suite.
-* :func:`observe` / :func:`histograms` — fixed-bucket histograms
-  (mempool admission latency, intake batch sizes) exported on
-  ``/metrics`` in Prometheus cumulative-bucket form
-  (``upow_<name>_bucket{le="..."}`` + ``_sum`` + ``_count``).
+``trace.py`` started as the whole observability story (flat span
+stats, counters, histograms, a jax-profiler wrapper) and grew into
+:mod:`upow_tpu.telemetry` (trace trees, events, kernel telemetry,
+Prometheus exposition).  Every pre-existing call site — and any code
+that prefers the short import — keeps working through this re-export;
+new code may import :mod:`upow_tpu.telemetry` directly for the
+tree/event APIs.
 """
 
 from __future__ import annotations
 
-import time
-from collections import defaultdict
-from contextlib import contextmanager
-from typing import Dict, Optional
-
-from .logger import get_logger
-
-log = get_logger("trace")
-
-_stats: Dict[str, dict] = defaultdict(
-    lambda: {"count": 0, "total_s": 0.0, "max_s": 0.0})
-
-_counters: Dict[str, int] = defaultdict(int)
-
-
-@contextmanager
-def span(name: str, level: str = "debug", **fields):
-    """Time a section; log '<name> took T s' plus any context fields."""
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter() - t0
-        s = _stats[name]
-        s["count"] += 1
-        s["total_s"] += dt
-        s["max_s"] = max(s["max_s"], dt)
-        extra = "".join(f" {k}={v}" for k, v in fields.items())
-        getattr(log, level, log.debug)("%s took %.3fs%s", name, dt, extra)
-
-
-def stats() -> Dict[str, dict]:
-    """Snapshot of span statistics: {name: {count, total_s, max_s}}."""
-    return {k: dict(v) for k, v in _stats.items()}
-
-
-def inc(name: str, n: int = 1) -> None:
-    """Bump a process-wide event counter (resilience/chaos observability).
-
-    Called from the event loop and executor threads; unlocked because a
-    lost increment under a rare interleave only skews an observability
-    counter, never chain state."""
-    _counters[name] += n
-
-
-def counters() -> Dict[str, int]:
-    """Snapshot of event counters: {name: count}."""
-    return dict(_counters)
-
-
-# Default buckets suit sub-second latencies; size-like metrics (batch
-# sizes, queue depths) pass their own buckets on first observe.
-_DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
-                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
-
-_hists: Dict[str, dict] = {}
-
-
-def observe(name: str, value, buckets=None) -> None:
-    """Record ``value`` into the named histogram.
-
-    Bucket bounds are fixed by the FIRST observation of each name
-    (later ``buckets`` arguments are ignored) — Prometheus scrapes
-    cannot follow bounds that change between exports.  Same locking
-    stance as :func:`inc`: a lost update only skews observability.
-    """
-    h = _hists.get(name)
-    if h is None:
-        bounds = tuple(sorted(buckets)) if buckets else _DEFAULT_BUCKETS
-        h = _hists[name] = {"bounds": bounds,
-                            "counts": [0] * (len(bounds) + 1),
-                            "sum": 0.0, "count": 0}
-    for i, bound in enumerate(h["bounds"]):
-        if value <= bound:
-            h["counts"][i] += 1
-            break
-    else:
-        h["counts"][-1] += 1  # +Inf overflow bucket
-    h["sum"] += value
-    h["count"] += 1
-
-
-def histograms() -> Dict[str, dict]:
-    """Snapshot: {name: {bounds, counts (per-bucket, +Inf last), sum,
-    count}}.  Counts are per-bucket, not cumulative — the /metrics
-    exporter does the cumulative sum the Prometheus format wants."""
-    return {k: {"bounds": v["bounds"], "counts": list(v["counts"]),
-                "sum": v["sum"], "count": v["count"]}
-            for k, v in _hists.items()}
-
-
-def reset() -> None:
-    _stats.clear()
-    _counters.clear()
-    _hists.clear()
-
-
-@contextmanager
-def profile(trace_dir: Optional[str] = None):
-    """Capture a JAX profiler trace into ``trace_dir`` (xprof format).
-
-    No-op when trace_dir is falsy or the profiler is unavailable.  Only
-    profiler SETUP/TEARDOWN failures are swallowed — exceptions raised
-    by the caller's body must propagate untouched (a yield inside a
-    try/except would eat them and then crash contextlib)."""
-    if not trace_dir:
-        yield
-        return
-    ctx = None
-    try:
-        import jax
-
-        ctx = jax.profiler.trace(trace_dir)
-        ctx.__enter__()
-    except Exception as e:  # profiling must never break the caller
-        log.warning("jax profiler unavailable: %s", e)
-        ctx = None
-    try:
-        yield
-    finally:
-        if ctx is not None:
-            try:
-                ctx.__exit__(None, None, None)
-            except Exception as e:
-                log.warning("jax profiler teardown failed: %s", e)
+from .telemetry import (TRACE_HEADER, add_span, attached,  # noqa: F401
+                        child_span, configure, counters, current_span,
+                        current_trace_id, ensure_counter,
+                        ensure_histogram, event, finish_child,
+                        histograms, inc, new_trace_id, observe,
+                        profile, request_trace, reset, span, stats,
+                        traces, valid_trace_id)

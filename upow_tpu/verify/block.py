@@ -32,6 +32,7 @@ from ..core.merkle import merkle_root
 from ..core.rewards import get_block_reward, get_inode_rewards
 from ..core.tx import CoinbaseTx, Tx, TxOutput
 from ..state.storage import ChainState, _INPUT_TABLE
+from ..trace import span
 from .txverify import TxVerifier, run_sig_checks_async
 
 # Historical chain patches: grandfathered double-spends by height and the
@@ -173,29 +174,32 @@ class BlockManager:
             mining_info = await self.calculate_difficulty()
         difficulty, last_block = mining_info
         block_no = (last_block["id"] + 1) if last_block else 1
-        try:
-            (previous_hash, address, merkle_tree, content_time,
-             content_difficulty, nonce) = split_block_content(block_content)
-        except (AssertionError, ValueError, NotImplementedError) as e:
-            errors.append(f"malformed block content: {e}")
-            return False
+        with span("block.header_check"):
+            try:
+                (previous_hash, address, merkle_tree, content_time,
+                 content_difficulty, nonce) = split_block_content(
+                     block_content)
+            except (AssertionError, ValueError, NotImplementedError) as e:
+                errors.append(f"malformed block content: {e}")
+                return False
 
-        # PoW vs the previous hash at current difficulty (manager.py:130-151)
-        if not check_pow(block_content,
-                         last_block.get("hash") if last_block else None,
-                         difficulty):
-            errors.append("block not valid")
-            return False
-        if last_block and previous_hash != last_block["hash"]:
-            errors.append("Previous hash is not matched")
-            return False
-        prev_ts = last_block.get("timestamp", 0) if last_block else 0
-        if prev_ts >= content_time:
-            errors.append("timestamp younger than previous block")
-            return False
-        if content_time > now_ts():
-            errors.append("timestamp in the future")
-            return False
+            # PoW vs the previous hash at current difficulty
+            # (manager.py:130-151)
+            if not check_pow(block_content,
+                             last_block.get("hash") if last_block else None,
+                             difficulty):
+                errors.append("block not valid")
+                return False
+            if last_block and previous_hash != last_block["hash"]:
+                errors.append("Previous hash is not matched")
+                return False
+            prev_ts = last_block.get("timestamp", 0) if last_block else 0
+            if prev_ts >= content_time:
+                errors.append("timestamp younger than previous block")
+                return False
+            if content_time > now_ts():
+                errors.append("timestamp in the future")
+                return False
 
         transactions = [tx for tx in transactions if not tx.is_coinbase]
         if sum(len(tx.hex()) for tx in transactions) > MAX_BLOCK_SIZE_HEX:
@@ -223,12 +227,14 @@ class BlockManager:
                 errors.append(f"transaction {tx.hash()} has been not verified")
                 return False
             all_checks.extend(checks)
-        if not all(await run_sig_checks_async(
+        with span("block.sig_verify", n=len(all_checks)):
+            verdicts_ok = all(await run_sig_checks_async(
                 all_checks, backend=self.sig_backend,
                 pad_block=self.verify_pad_block,
                 device_timeout=self.verify_device_timeout,
                 precomputed=self.page_sig_verdicts,
-                mesh_devices=self.verify_mesh_devices)):
+                mesh_devices=self.verify_mesh_devices))
+        if not verdicts_ok:
             errors.append("signature verification failed")
             return False
 
@@ -268,8 +274,6 @@ class BlockManager:
                            last_block: Optional[dict] = None,
                            errors: Optional[list] = None) -> bool:
         """Validate + apply one mined block (manager.py:650-757)."""
-        from ..trace import span
-
         errors = errors if errors is not None else []
         async with self._accept_lock:
             with span("block_accept", level="info", txs=len(transactions)):
@@ -323,21 +327,24 @@ class BlockManager:
             errors.append("invalid coinbase outputs")
             return False
 
-        async with self.state.atomic():
-            await self.state.add_block(
-                block_no, block_hash, block_content, address, nonce,
-                difficulty, block_reward + fees, content_time)
-            await self.state.add_transaction(coinbase, block_hash)
-            await self.state.add_transactions(transactions, block_hash)
-            await self.state.add_transaction_outputs(
-                list(transactions) + [coinbase])
-            if transactions:
-                await self.state.remove_pending_transactions_by_hash(
-                    [tx.hash() for tx in transactions])
-                await self.state.remove_outputs(transactions)
+        with span("block.utxo_apply", txs=len(transactions)):
+            async with self.state.atomic():
+                await self.state.add_block(
+                    block_no, block_hash, block_content, address, nonce,
+                    difficulty, block_reward + fees, content_time)
+                await self.state.add_transaction(coinbase, block_hash)
+                await self.state.add_transactions(transactions, block_hash)
+                await self.state.add_transaction_outputs(
+                    list(transactions) + [coinbase])
+                if transactions:
+                    await self.state.remove_pending_transactions_by_hash(
+                        [tx.hash() for tx in transactions])
+                    await self.state.remove_outputs(transactions)
         # outside the atomic block: the pool must only drop entries for
         # a COMMITTED acceptance
-        self._notify_pending_removed([tx.hash() for tx in transactions])
+        with span("block.mempool_remove"):
+            self._notify_pending_removed(
+                [tx.hash() for tx in transactions])
 
         if block_no % 10 == 0:
             fingerprint = await self.state.get_unspent_outputs_hash()
@@ -395,19 +402,22 @@ class BlockManager:
             errors.append("invalid coinbase outputs")
             return False
 
-        async with self.state.atomic():
-            await self.state.add_block(
-                block_no, block_hash, block_content, address, nonce,
-                difficulty, block_reward + fees, content_time)
-            await self.state.add_transaction(coinbase, block_hash)
-            await self.state.add_transactions(transactions, block_hash)
-            await self.state.add_transaction_outputs(
-                list(transactions) + [coinbase])
-            if transactions:
-                await self.state.remove_pending_transactions_by_hash(
-                    [tx.hash() for tx in transactions])
-                await self.state.remove_outputs(transactions)
-        self._notify_pending_removed([tx.hash() for tx in transactions])
+        with span("block.utxo_apply", txs=len(transactions)):
+            async with self.state.atomic():
+                await self.state.add_block(
+                    block_no, block_hash, block_content, address, nonce,
+                    difficulty, block_reward + fees, content_time)
+                await self.state.add_transaction(coinbase, block_hash)
+                await self.state.add_transactions(transactions, block_hash)
+                await self.state.add_transaction_outputs(
+                    list(transactions) + [coinbase])
+                if transactions:
+                    await self.state.remove_pending_transactions_by_hash(
+                        [tx.hash() for tx in transactions])
+                    await self.state.remove_outputs(transactions)
+        with span("block.mempool_remove"):
+            self._notify_pending_removed(
+                [tx.hash() for tx in transactions])
         self.invalidate_difficulty()
         return True
 

@@ -331,9 +331,19 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
                 digests, sigs, pubs, pad_block=pad_block,
                 mesh=_verify_mesh(mesh_devices))
 
+        import time as _time
+
+        from .. import trace as _trace
+
+        t0 = _time.perf_counter()
         status, value = boxed_call(
             dispatch,
             timeout=device_timeout)  # generous: covers first-call compile
+        from ..telemetry.device import DISPATCH_BUCKETS as _DISPATCH_BUCKETS
+
+        _trace.observe("kernel.p256_verify.dispatch_seconds",
+                       _time.perf_counter() - t0,
+                       buckets=_DISPATCH_BUCKETS)
         log = logging.getLogger("upow_tpu.verify")
         if status == "ok":
             DEGRADE.record_success()
