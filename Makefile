@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: lint test ruff metrics-check
+.PHONY: lint test ruff metrics-check perf-observatory perf-smoke
 
 # Domain linter: consensus-endianness, consensus-purity, jit-purity,
 # dtype-hygiene, async-safety, broad-except.  Stdlib-only; exits 1 on
@@ -27,3 +27,17 @@ test:
 # the required kernel/chain metric families (docs/OBSERVABILITY.md).
 metrics-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.telemetry.selfcheck
+
+# Full perf observatory: wallet-population load against the in-process
+# node + kernel benches, merged into observatory.json with provenance,
+# one trajectory line appended to PROGRESS.jsonl.  Gate the artifact
+# against a baseline with:
+#   $(PYTHON) -m upow_tpu.loadgen.gate --against BENCH_r05.json
+perf-observatory:
+	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.loadgen \
+		--out observatory.json --progress PROGRESS.jsonl
+
+# CI-sized variant: tiny population, no PROGRESS append.
+perf-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.loadgen --smoke \
+		--out observatory-smoke.json

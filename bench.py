@@ -107,7 +107,33 @@ def _attach_last_good(result: dict) -> dict:
     return result
 
 
-def _reexec_cpu_child() -> int:
+_ARM_FAILURE_ENV = "UPOW_BENCH_ARM_FAILURE"
+_ARM_ATTEMPTED_ENV = "UPOW_BENCH_ATTEMPTED_BACKEND"
+
+
+def _emit_arm_failed(reason: str, attempted: str = "tpu") -> None:
+    """Record the structured ``bench_arm_failed`` telemetry event; a
+    telemetry hiccup must never take the bench down with it."""
+    try:
+        from upow_tpu import telemetry
+
+        telemetry.event("bench_arm_failed", reason=reason,
+                        attempted_backend=attempted, source="bench")
+    except Exception as e:
+        sys.stderr.write(f"bench_arm_failed event not recorded: {e}\n")
+
+
+def _attach_arm_provenance(result: dict, platform=None) -> dict:
+    """Stamp what was attempted vs what actually ran.  The CPU child
+    inherits the parent's failure reason via env, so the single JSON
+    line the driver captures carries the whole story."""
+    result["attempted_backend"] = os.environ.get(
+        _ARM_ATTEMPTED_ENV, platform)
+    result["arm_failure_reason"] = os.environ.get(_ARM_FAILURE_ENV)
+    return result
+
+
+def _reexec_cpu_child(reason: str) -> int:
     """Re-run this script in a scrubbed-env child pinned to XLA:CPU.
 
     The axon PJRT plugin force-overrides JAX_PLATFORMS from
@@ -122,6 +148,8 @@ def _reexec_cpu_child() -> int:
                                 "AXON_", "PALLAS_AXON_", "PYTHONPATH"))}
     env[_CPU_CHILD_MARKER] = "1"
     env["JAX_PLATFORMS"] = "cpu"
+    env[_ARM_FAILURE_ENV] = reason
+    env[_ARM_ATTEMPTED_ENV] = "tpu"
     proc = subprocess.run([sys.executable] + sys.argv, env=env)
     return proc.returncode
 
@@ -314,20 +342,30 @@ def main() -> int:
     if platform is None:
         if os.environ.get(_CPU_CHILD_MARKER):
             # even the clean CPU child failed: emit the honest zero line
-            print(json.dumps(_attach_last_good({
+            _emit_arm_failed("no jax backend available in scrubbed cpu child",
+                             attempted="cpu")
+            print(json.dumps(_attach_arm_provenance(_attach_last_good({
                 "metric": "sha256_pow_search_none_none",
                 "value": 0.0, "unit": "MH/s", "vs_baseline": 0.0,
                 "error": "no jax backend available",
-            })))
+            }))))
             return 0
         if args.require_tpu:
             sys.stderr.write("--require-tpu: backend hung, not falling back\n")
             return 3
+        reason = "backend probe hung/failed; scrubbed-env cpu child fallback"
+        _emit_arm_failed(reason)
         sys.stderr.write("falling back to scrubbed-env CPU child\n")
-        return _reexec_cpu_child()
+        return _reexec_cpu_child(reason)
     if args.require_tpu and platform == "cpu":
         sys.stderr.write("--require-tpu: only cpu available\n")
         return 3
+    if platform == "cpu" and not os.environ.get(_CPU_CHILD_MARKER):
+        # armed, but the probe only ever saw cpu — record it so the
+        # emitted line distinguishes "cpu host" from "tpu degraded"
+        os.environ.setdefault(_ARM_FAILURE_ENV, "only cpu visible to jax")
+        os.environ.setdefault(_ARM_ATTEMPTED_ENV, "tpu")
+        _emit_arm_failed(os.environ[_ARM_FAILURE_ENV])
     if args.batch == 0:
         args.batch = 1 << 20 if platform == "cpu" else 1 << 28
     if platform == "cpu" and args.batch > 1 << 20:
@@ -447,7 +485,7 @@ def main() -> int:
 
     if platform == "cpu":
         result = _attach_last_good(result)
-    print(json.dumps(result))
+    print(json.dumps(_attach_arm_provenance(result, platform)))
     return 0
 
 
@@ -458,9 +496,9 @@ if __name__ == "__main__":
         raise
     except BaseException as e:  # always leave a parseable line for the driver
         traceback.print_exc()
-        print(json.dumps(_attach_last_good({
+        print(json.dumps(_attach_arm_provenance(_attach_last_good({
             "metric": "sha256_pow_search_error",
             "value": 0.0, "unit": "MH/s", "vs_baseline": 0.0,
             "error": f"{type(e).__name__}: {e}"[:300],
-        })))
+        }))))
         raise SystemExit(0)

@@ -14,6 +14,8 @@ configs, one JSON line each.
 7. host-vs-device batched txid hashing crossover (sync pages)
 8. push_tx intake over real localhost HTTP (per-tx gossip ingest)
 9. end-to-end HTTP chain sync, wire to state (cold catch-up)
+10. coalesced push_tx waves through the micro-batching intake
+11. perf observatory: wallet-population loadgen SLO + kernel artifact
 
 ``bench.py`` stays the driver-facing single-line headline (sha256
 search + the verify sub-metric); this suite is the full scoreboard.
@@ -309,80 +311,17 @@ def _python_verify_baseline(seconds: float = 1.0) -> float:
 
 
 async def _chain_with_utxo_fanout(n_fan: int, n_per: int, rng_key: int):
-    """3-block chain fanning one coinbase into n_fan x n_per spendable
-    leaf outputs (shared scaffolding for the accept/intake configs).
-    Returns (state, manager, keys..., mids, mine_block) where
-    ``mine_block(txs)`` accepts one more block and returns its accept
-    seconds."""
-    from decimal import Decimal
+    """Funded-chain scaffolding, now shared with the loadgen fixture —
+    moved to upow_tpu.benchutil.chain_with_utxo_fanout."""
+    from upow_tpu.benchutil import chain_with_utxo_fanout
 
-    from upow_tpu.core import clock, curve, difficulty, point_to_string
-    from upow_tpu.core.header import BlockHeader
-    from upow_tpu.core.merkle import merkle_root
-    from upow_tpu.core.tx import Tx, TxInput, TxOutput
-    from upow_tpu.mine.engine import MiningJob, mine
-    from upow_tpu.state import ChainState
-    from upow_tpu.verify import BlockManager
-
-    difficulty.START_DIFFICULTY = Decimal("1.0")
-    GENESIS_PREV = (18_884_643).to_bytes(32, "little").hex()
-
-    state = ChainState()
-    manager = BlockManager(state)
-    d, pub = curve.keygen(rng=rng_key)
-    addr = point_to_string(pub)
-    pub_of = lambda _i: pub
-
-    async def mine_block(txs):
-        clock.advance(60)
-        diff, last = await manager.calculate_difficulty()
-        prev = last["hash"] if last else GENESIS_PREV
-        header = BlockHeader(
-            previous_hash=prev, address=addr, merkle_root=merkle_root(txs),
-            timestamp=clock.timestamp(), difficulty_x10=int(diff * 10),
-            nonce=0)
-        if last:
-            r = mine(MiningJob(header.prefix_bytes(), prev, diff),
-                     "python", batch=1 << 14, ttl=600)
-            header.nonce = r.nonce
-        errors = []
-        t0 = time.perf_counter()
-        ok = await manager.create_block(header.hex(), txs, errors=errors)
-        dt = time.perf_counter() - t0
-        assert ok, errors
-        return dt
-
-    await mine_block([])                      # block 1: coinbase to addr
-    coin = (await state.get_spendable_outputs(addr))[0]
-    reward = coin.amount
-
-    per = reward // n_fan
-    outs = [TxOutput(addr, per)] * (n_fan - 1)
-    outs = outs + [TxOutput(addr, reward - per * (n_fan - 1))]
-    fan = Tx([coin], outs).sign([d], pub_of)
-    await mine_block([fan])
-
-    mids = []
-    for j in range(n_fan):
-        amt = fan.outputs[j].amount
-        sub = amt // n_per
-        souts = [TxOutput(addr, sub)] * (n_per - 1)
-        souts = souts + [TxOutput(addr, amt - sub * (n_per - 1))]
-        mids.append(Tx([TxInput(fan.hash(), j)], souts).sign([d], pub_of))
-    await mine_block(mids)
-    return state, manager, d, pub, addr, mids, mine_block
+    return await chain_with_utxo_fanout(n_fan, n_per, rng_key)
 
 
 def _leaf_spends(parents, addr, d, pub):
-    from upow_tpu.core.tx import Tx, TxInput, TxOutput
+    from upow_tpu.benchutil import leaf_spends
 
-    out = []
-    for m in parents:
-        h = m.hash()
-        for k, o in enumerate(m.outputs):
-            out.append(Tx([TxInput(h, k)], [TxOutput(addr, o.amount)])
-                       .sign([d], lambda _i: pub))
-    return out
+    return leaf_spends(parents, addr, d, pub)
 
 
 def config6_block8k(seconds: float):
@@ -578,6 +517,32 @@ def config10_coalesced_intake(seconds: float):
     _emit(f"push_tx_coalesced_{_platform()}", rate, "tx/s", base_rate)
 
 
+def config11_perf_observatory(seconds: float):
+    """The perf observatory: seeded wallet-population loadgen against
+    the in-process node (Zipf reads, miner polling, push_tx bursts, ws
+    churn) merged with kernel benches into one artifact
+    (``observatory.json``) that the regression gate consumes.  Emits a
+    suite-shaped line per endpoint so the driver's capture carries the
+    SLO scoreboard too."""
+    from upow_tpu.loadgen.observatory import (append_progress,
+                                              run_observatory,
+                                              write_artifact)
+    from upow_tpu.loadgen.population import PopulationSpec
+
+    spec = PopulationSpec(duration=min(seconds, 4.0))
+    artifact = run_observatory(spec, bench_seconds=min(seconds / 4, 1.0))
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "observatory.json")
+    write_artifact(artifact, out_path)
+    progress = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "PROGRESS.jsonl")
+    append_progress(artifact, progress)
+
+    for ep, row in sorted(artifact["slo"]["endpoints"].items()):
+        _emit(f"slo_{ep}_req_s", row["req_s"] or 0.0, "req/s", None)
+        _emit(f"slo_{ep}_p95", row["p95_ms"], "ms", None)
+
+
 def config9_sync(seconds: float):
     """End-to-end chain sync over real localhost HTTP: node B downloads
     node A's chain in pages (prefetch pipeline, page-level signature
@@ -715,6 +680,7 @@ def main() -> int:
         "8": lambda: config8_intake(args.seconds),
         "9": lambda: config9_sync(args.seconds),
         "10": lambda: config10_coalesced_intake(args.seconds),
+        "11": lambda: config11_perf_observatory(args.seconds),
     }
     needs_device = {"2", "3", "5", "7"}
     failed = []

@@ -1,0 +1,342 @@
+"""Perf-observatory tests: schedule determinism, SLO histogram
+exposition, the regression gate's exit codes, debug-endpoint limit
+hardening, and XLA cost-analysis recording.
+
+The in-process-node integration lives in ``test_loadgen_node`` — the
+pure pieces here run without booting anything, so the determinism
+claims are tested exactly where they're made (mock backend, pure
+latency function of the seed).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from test_node import Cluster, easy_difficulty  # noqa: F401
+from upow_tpu import telemetry
+from upow_tpu.loadgen import gate
+from upow_tpu.loadgen.population import (PopulationSpec, build_schedule,
+                                         schedule_fingerprint)
+from upow_tpu.loadgen.runner import MockBackend, run_mock, run_schedule
+from upow_tpu.telemetry import exposition, metrics, slo
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.configure()
+
+
+# ------------------------------------------------------ determinism ----
+
+def test_schedule_deterministic():
+    """Same seed -> byte-identical schedule; different seed differs."""
+    a = build_schedule(PopulationSpec.smoke())
+    b = build_schedule(PopulationSpec.smoke())
+    assert schedule_fingerprint(a) == schedule_fingerprint(b)
+    assert [e.at for e in a] == sorted(e.at for e in a)
+    c = build_schedule(PopulationSpec.smoke(seed=0xDEAD))
+    assert schedule_fingerprint(a) != schedule_fingerprint(c)
+
+
+def test_schedule_covers_all_actor_streams():
+    kinds = {e.kind for e in build_schedule(PopulationSpec.smoke())}
+    assert {"balance", "mining_info", "push_tx",
+            "ws_connect", "ws_ping", "ws_close"} <= kinds
+
+
+def test_push_bursts_share_timestamp():
+    """Burst members land at an identical instant — that simultaneity
+    is what drives the intake's micro-batch coalescing."""
+    spec = PopulationSpec.smoke()
+    events = build_schedule(spec)
+    bursts = {}
+    for e in events:
+        if e.kind == "push_tx":
+            bursts.setdefault(e.at, []).append(e)
+    assert len(bursts) == spec.push_bursts
+    assert all(len(v) == spec.burst_size for v in bursts.values())
+
+
+def test_mock_summary_deterministic():
+    """Same seed -> identical summary (modulo wall clock), twice."""
+    s1 = run_mock(PopulationSpec.smoke(), record_slo=False)
+    s2 = run_mock(PopulationSpec.smoke(), record_slo=False)
+    s1.pop("wall_s"), s2.pop("wall_s")
+    assert s1 == s2
+    assert s1["endpoints"]["push_tx"]["requests"] == 16
+
+
+def test_zipf_read_skew():
+    """Rank 0 must absorb more reads than any deep-tail rank."""
+    spec = PopulationSpec(duration=4.0, n_readers=8)
+    hits = {}
+    for e in build_schedule(spec):
+        w = e.param("wallet")
+        if w is not None:
+            hits[w] = hits.get(w, 0) + 1
+    assert hits.get(0, 0) > hits.get(spec.n_wallets - 1, 0)
+    assert hits.get(0, 0) >= max(hits.values()) * 0.5
+
+
+def test_runner_survives_executor_crash():
+    """An executor exception becomes a synthetic 599, not an abort."""
+    events = build_schedule(PopulationSpec.smoke())
+
+    async def boom(ev):
+        raise RuntimeError("injected")
+
+    results = asyncio.run(run_schedule(events, boom))
+    assert len(results) == len(events)
+    assert all(r.status == 599 and not r.ok for r in results)
+
+
+# ------------------------------------------- slo histograms /metrics ----
+
+def test_slo_exposition_valid():
+    """The SLO histograms render to valid exposition text with the
+    cumulative +Inf invariant intact."""
+    run_mock(PopulationSpec.smoke(), record_slo=True)
+    e = exposition.Exposition()
+    for name, h in metrics.histograms().items():
+        e.histogram(name, h["bounds"], h["counts"], h["count"], h["sum"])
+    text = e.render()
+    assert "upow_slo_http_push_tx_latency_seconds_bucket" in text
+    assert exposition.validate(text) == []
+    # +Inf cumulative == _count for the push_tx series
+    lines = [ln for ln in text.splitlines() if "push_tx" in ln]
+    inf = next(ln for ln in lines if 'le="+Inf"' in ln)
+    count = next(ln for ln in lines if ln.startswith(
+        "upow_slo_http_push_tx_latency_seconds_count"))
+    assert inf.rsplit(" ", 1)[1] == count.rsplit(" ", 1)[1] != "0"
+
+
+def test_slo_summary_quantiles():
+    for _ in range(90):
+        slo.observe_request("/x", 0.003)
+    for _ in range(10):
+        slo.observe_request("/x", 0.2, status=502)
+    row = slo.summary()["x"]
+    assert row["requests"] == 100 and row["errors"] == 10
+    assert 2.0 <= row["p50_ms"] <= 5.0
+    assert row["p95_ms"] > row["p50_ms"]
+    assert 100.0 <= row["p99_ms"] <= 250.0
+
+
+def test_slo_quantile_edge_cases():
+    assert slo.quantile({"bounds": (1,), "counts": (0, 0),
+                         "count": 0, "sum": 0.0}, 0.5) is None
+    # everything in the +Inf overflow clamps to the top finite bound
+    est = slo.quantile({"bounds": (0.001, 0.01), "counts": (0, 0, 7),
+                        "count": 7, "sum": 3.0}, 0.5)
+    assert est == 0.01
+
+
+def test_mock_backend_feeds_slo_registry():
+    asyncio.run(MockBackend(seed=7)(
+        build_schedule(PopulationSpec.smoke())[0]))
+    assert any(n.startswith("slo.http.") for n in metrics.histograms())
+
+
+# -------------------------------------------------- regression gate ----
+
+def _artifact(p95=10.0, req_s=100.0, kernel=5.0):
+    return {"kind": "perf_observatory",
+            "slo": {"endpoints": {"push_tx": {
+                "req_s": req_s, "p50_ms": p95 / 2, "p95_ms": p95,
+                "p99_ms": p95 * 1.2}}},
+            "kernels": {"search_python_loop":
+                        {"value": kernel, "unit": "MH/s"}}}
+
+
+def _write(tmp_path, name, doc):
+    path = tmp_path / name
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+def test_gate_fails_on_latency_regression(tmp_path, capsys):
+    base = _write(tmp_path, "base.json", _artifact())
+    cur = _write(tmp_path, "cur.json", _artifact(p95=20.0))
+    assert gate.main(["--against", base, "--current", cur]) == 1
+    report = json.loads(capsys.readouterr().out)
+    regressed = {r["metric"] for r in report["verdicts"] if r["regressed"]}
+    assert "slo.push_tx.p95_ms" in regressed
+    assert "slo.push_tx.req_s" not in regressed  # unchanged metric clean
+
+
+def test_gate_fails_on_throughput_regression(tmp_path):
+    base = _write(tmp_path, "base.json", _artifact())
+    cur = _write(tmp_path, "cur.json", _artifact(kernel=1.0))
+    assert gate.main(["--against", base, "--current", cur]) == 1
+
+
+def test_gate_passes_within_tolerance_and_on_improvement(tmp_path):
+    base = _write(tmp_path, "base.json", _artifact())
+    # 10% slower: inside the default 25% band
+    cur = _write(tmp_path, "cur.json", _artifact(p95=11.0))
+    assert gate.main(["--against", base, "--current", cur]) == 0
+    # faster everywhere: improvements never fail
+    cur = _write(tmp_path, "cur.json",
+                 _artifact(p95=1.0, req_s=900.0, kernel=50.0))
+    assert gate.main(["--against", base, "--current", cur]) == 0
+
+
+def test_gate_report_only_and_tolerance_flags(tmp_path):
+    base = _write(tmp_path, "base.json", _artifact())
+    cur = _write(tmp_path, "cur.json", _artifact(p95=20.0))
+    assert gate.main(["--against", base, "--current", cur,
+                      "--report-only"]) == 0
+    assert gate.main(["--against", base, "--current", cur,
+                      "--tolerance", "2.0"]) == 0
+
+
+def test_gate_flattens_bench_wrapper(tmp_path):
+    """The driver's BENCH_r*.json capture shape gates transparently."""
+    wrapper = {"n": 5, "cmd": "python bench.py", "rc": 0, "tail": "...",
+               "parsed": {"metric": "sha256_pow_search_native_cpu",
+                          "value": 16.5, "unit": "MH/s",
+                          "verify": {"metric": "verify_batch_native_cpu",
+                                     "value": 3531.0}}}
+    flat = gate.load_metrics(_write(tmp_path, "bench.json", wrapper))
+    assert flat == {"sha256_pow_search_native_cpu": 16.5,
+                    "verify_batch_native_cpu": 3531.0}
+    regressed = dict(wrapper, parsed=dict(wrapper["parsed"], value=1.0))
+    base = _write(tmp_path, "b.json", wrapper)
+    cur = _write(tmp_path, "c.json", regressed)
+    assert gate.main(["--against", base, "--current", cur]) == 1
+
+
+def test_gate_jsonl_stream(tmp_path):
+    """bench_suite's JSON-lines output parses line by line."""
+    path = tmp_path / "suite.jsonl"
+    path.write_text(
+        'noise line\n'
+        '{"metric": "a_rate", "value": 10, "unit": "x"}\n'
+        '{"metric": "b_ms", "value": 5, "unit": "ms"}\n')
+    assert gate.load_metrics(str(path)) == {"a_rate": 10.0, "b_ms": 5.0}
+
+
+def test_gate_missing_artifact_is_usage_error(tmp_path):
+    base = _write(tmp_path, "base.json", _artifact())
+    assert gate.main(["--against", str(tmp_path / "nope.json"),
+                      "--current", base]) == 2
+
+
+def test_gate_direction_inference():
+    assert gate.lower_is_better("slo.push_tx.p95_ms")
+    assert gate.lower_is_better("intake_latency_seconds")
+    assert not gate.lower_is_better("sha256_pow_search_native_cpu")
+    assert not gate.lower_is_better("kernel.verify_python")
+
+
+# ------------------------------------------- debug-endpoint limits ----
+
+def test_debug_limit_hardening(tmp_path):
+    """Negative limits clamp to 0, oversized clamp to the cap, and
+    non-integers are a 400 — never a 500."""
+    async def scenario(cluster):
+        _node, client = await cluster.add_node("a")
+        for i in range(5):
+            telemetry.event("breaker", peer=f"p{i}", state="open")
+
+        res = await client.get("/debug/events", params={"limit": "-3"})
+        assert res.status == 200
+        assert len((await res.json())["result"]) == 5  # clamped to "all"
+
+        res = await client.get("/debug/events", params={"limit": "2"})
+        assert len((await res.json())["result"]) == 2
+
+        res = await client.get("/debug/events",
+                               params={"limit": "99999999999"})
+        assert res.status == 200  # clamped to the cap, served
+
+        # empty string means "not provided" (default), not an error
+        res = await client.get("/debug/events", params={"limit": ""})
+        assert res.status == 200
+
+        for bad in ("abc", "1.5", "2x"):
+            res = await client.get("/debug/events", params={"limit": bad})
+            assert res.status == 400, bad
+            body = await res.json()
+            assert body["ok"] is False and "integer" in body["error"]
+
+        res = await client.get("/debug/traces", params={"limit": "abc"})
+        assert res.status == 400
+        res = await client.get("/debug/traces", params={"limit": "-1"})
+        assert res.status == 200
+
+    async def main():
+        cluster = Cluster(tmp_path)
+        try:
+            await scenario(cluster)
+        finally:
+            await cluster.close()
+
+    asyncio.run(main())
+
+
+# ------------------------------------------- cost-analysis capture ----
+
+def test_cost_analysis_recorded():
+    """analyze_cost on a trivial program lands numeric estimates in the
+    device registry (and tolerates backends without cost_analysis)."""
+    from upow_tpu import profiling
+    from upow_tpu.telemetry import device
+
+    import jax.numpy as jnp
+
+    def f(x):
+        return (x * 2.0 + 1.0).sum()
+
+    out = profiling.analyze_cost("toy_sum", f, jnp.ones((8, 8)))
+    if out is None:  # backend exposes no cost model: recorded nothing
+        assert "toy_sum" not in device.cost_estimates()
+        return
+    assert all(isinstance(v, float) for v in out.values())
+    stored = device.cost_estimates()["toy_sum"]
+    assert stored and all(" " not in k and "-" not in k for k in stored)
+
+
+def test_record_cost_bounds():
+    from upow_tpu.telemetry import device
+
+    for i in range(200):
+        device.record_cost(f"k{i}", {"flops": float(i)})
+    assert len(device.cost_estimates()) <= 64
+    device.record_cost("wide", {f"key{i}": 1.0 for i in range(50)})
+    wide = device.cost_estimates().get("wide")
+    assert wide is None or len(wide) <= 16
+
+
+# ------------------------------------------------- profiler session ----
+
+def test_profile_status_lifecycle(tmp_path):
+    from upow_tpu import profiling
+
+    profiling.reset()
+    assert profiling.status()["active"] is False
+    res = profiling.start(str(tmp_path / "traces"), max_seconds=60.0)
+    try:
+        if "error" in res:  # backend can't trace: status must stay clean
+            assert profiling.status()["active"] is False
+            return
+        assert profiling.status()["active"] is True
+        again = profiling.start(str(tmp_path / "traces2"))
+        assert "error" in again  # one capture at a time
+    finally:
+        profiling.stop()
+    assert profiling.status()["active"] is False
+
+
+def test_config_profile_env(monkeypatch):
+    from upow_tpu.config import Config
+
+    monkeypatch.setenv("UPOW_PROFILE_ENABLED", "1")
+    monkeypatch.setenv("UPOW_PROFILE_MAX_CAPTURE_SECONDS", "7.5")
+    cfg = Config.load(path=None)
+    assert cfg.profile.enabled is True
+    assert cfg.profile.max_capture_seconds == 7.5

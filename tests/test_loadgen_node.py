@@ -1,0 +1,214 @@
+"""Perf-observatory integration: the load generator against a real
+in-process node, the merged artifact, and the /debug/profile endpoint.
+
+Kept separate from test_loadgen.py because these boot nodes and build
+funded chain fixtures (seconds, not milliseconds); the pure-logic
+determinism and gate tests shouldn't pay for that.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from upow_tpu import telemetry
+from upow_tpu.loadgen.population import PopulationSpec
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.configure()
+
+
+@pytest.fixture(autouse=True)
+def restore_difficulty():
+    """chain_with_utxo_fanout pins START_DIFFICULTY process-globally."""
+    from upow_tpu.core import clock, difficulty
+
+    saved = difficulty.START_DIFFICULTY
+    yield
+    difficulty.START_DIFFICULTY = saved
+    clock.reset()
+
+
+def test_loadgen_against_node():
+    """The smoke population drives every endpoint class through the
+    real node with zero transport errors, and the node's own SLO
+    histograms (middleware-fed) agree on the request counts."""
+    from upow_tpu.loadgen.harness import run_against_node
+
+    spec = PopulationSpec.smoke()
+    summary = asyncio.run(run_against_node(spec))
+
+    eps = summary["endpoints"]
+    assert {"get_address_info", "get_mining_info", "push_tx",
+            "ws"} <= set(eps)
+    for ep, row in eps.items():
+        assert row["errors"] == 0, (ep, row)
+        assert row["p50_ms"] > 0 and row["p95_ms"] >= row["p50_ms"]
+    assert eps["push_tx"]["requests"] == spec.push_bursts * spec.burst_size
+
+    # server-side SLO histograms saw the same traffic
+    server = summary["server_slo"]
+    assert server["push_tx"]["requests"] == eps["push_tx"]["requests"]
+    assert server["get_mining_info"]["p95_ms"] > 0
+
+    # ws churn reached the hub and every socket was closed again
+    ws = summary["ws_hub"]
+    assert ws["connects_total"] == spec.n_ws * spec.ws_churn
+    assert ws["disconnects_total"] == ws["connects_total"]
+    assert ws["total_connections"] == 0
+
+
+def test_observatory_artifact_and_gate(tmp_path):
+    """Acceptance path: one run_observatory() artifact carries SLO +
+    kernels + provenance, self-gates clean, and an injected synthetic
+    regression makes the gate exit non-zero."""
+    from upow_tpu.loadgen import gate
+    from upow_tpu.loadgen.observatory import (append_progress,
+                                              run_observatory,
+                                              write_artifact)
+
+    artifact = run_observatory(PopulationSpec.smoke(), bench_seconds=0.05)
+    assert artifact["kind"] == "perf_observatory"
+    assert artifact["provenance"]["backend"] == "node-inprocess"
+    assert "arm_failure_reason" in artifact["provenance"]
+    assert artifact["kernels"]["search_python_loop"]["value"] > 0
+    assert artifact["slo"]["endpoints"]["push_tx"]["req_s"] > 0
+
+    out = tmp_path / "observatory.json"
+    write_artifact(artifact, str(out))
+    on_disk = json.loads(out.read_text())
+    assert on_disk["schedule_fingerprint"] == \
+        artifact["schedule_fingerprint"]
+
+    progress = tmp_path / "PROGRESS.jsonl"
+    append_progress(artifact, str(progress))
+    line = json.loads(progress.read_text().splitlines()[-1])
+    assert line["kind"] == "perf_observatory"
+    assert line["slo"]["push_tx"]["p95_ms"] > 0
+    assert line["kernels"]["search_python_loop"] > 0
+
+    # identical artifact: clean pass
+    assert gate.main(["--against", str(out), "--current", str(out)]) == 0
+
+    # injected synthetic regression: non-zero exit
+    worse = json.loads(out.read_text())
+    worse["slo"]["endpoints"]["push_tx"]["p95_ms"] *= 10
+    worse_path = tmp_path / "worse.json"
+    worse_path.write_text(json.dumps(worse))
+    assert gate.main(["--against", str(out),
+                      "--current", str(worse_path)]) == 1
+
+
+def test_node_metrics_exports_slo_series(tmp_path):
+    """/metrics carries the middleware-fed SLO histogram for a route
+    that was actually hit, and the full page validates."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from upow_tpu.config import Config
+    from upow_tpu.node.app import Node
+    from upow_tpu.telemetry import exposition
+
+    async def scenario():
+        cfg = Config()
+        cfg.node.db_path = ""
+        cfg.node.seed_url = ""
+        cfg.node.peers_file = str(tmp_path / "nodes.json")
+        cfg.node.ip_config_file = ""
+        cfg.log.path = ""
+        cfg.log.console = False
+        node = Node(cfg)
+        server = TestServer(node.app)
+        await server.start_server()
+        client = TestClient(server)
+        node.started = True
+        try:
+            for _ in range(3):
+                await client.get("/get_mining_info")
+            resp = await client.get("/metrics")
+            text = await resp.text()
+        finally:
+            await client.close()
+            await server.close()
+            await node.close()
+        return text
+
+    text = asyncio.run(scenario())
+    assert exposition.validate(text) == []
+    assert "upow_slo_http_get_mining_info_latency_seconds_bucket" in text
+    count_line = next(
+        ln for ln in text.splitlines()
+        if ln.startswith("upow_slo_http_get_mining_info_latency_seconds_count"))
+    assert float(count_line.rsplit(" ", 1)[1]) >= 3
+    # preregistered-but-unhit endpoints export all-zero series too
+    assert "upow_slo_http_push_tx_latency_seconds_count 0" in text
+    # /metrics itself is excluded from nothing — but /debug and /ws are
+    assert "upow_slo_http_debug" not in text
+
+
+def test_debug_profile_endpoint(tmp_path):
+    """The opt-in /debug/profile endpoint: 404 when disabled, start/
+    status/stop lifecycle when enabled, 400 on unknown actions."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from upow_tpu import profiling
+    from upow_tpu.config import Config
+    from upow_tpu.node.app import Node
+
+    def make_cfg(enabled):
+        cfg = Config()
+        cfg.node.db_path = ""
+        cfg.node.seed_url = ""
+        cfg.node.peers_file = str(tmp_path / "nodes.json")
+        cfg.node.ip_config_file = ""
+        cfg.log.path = ""
+        cfg.log.console = False
+        cfg.profile.enabled = enabled
+        cfg.profile.trace_dir = str(tmp_path / "traces")
+        return cfg
+
+    async def scenario(enabled):
+        node = Node(make_cfg(enabled))
+        server = TestServer(node.app)
+        await server.start_server()
+        client = TestClient(server)
+        node.started = True
+        out = {}
+        try:
+            out["disabled"] = (await client.get("/debug/profile")).status
+            if enabled:
+                res = await client.get("/debug/profile",
+                                       params={"action": "status"})
+                out["status"] = await res.json()
+                res = await client.get("/debug/profile",
+                                       params={"action": "bogus"})
+                out["bogus"] = res.status
+                res = await client.get("/debug/profile",
+                                       params={"action": "start"})
+                out["start"] = await res.json()
+                res = await client.get("/debug/profile",
+                                       params={"action": "stop"})
+                out["stop"] = await res.json()
+        finally:
+            await client.close()
+            await server.close()
+            await node.close()
+            profiling.reset()
+        return out
+
+    off = asyncio.run(scenario(enabled=False))
+    assert off["disabled"] == 404
+
+    on = asyncio.run(scenario(enabled=True))
+    assert on["disabled"] == 200
+    assert on["status"]["ok"] and on["status"]["result"] == {
+        "active": False}
+    assert on["bogus"] == 400
+    if on["start"]["ok"]:  # CPU backends may refuse to trace; both fine
+        assert on["stop"]["ok"]
+    else:
+        assert "error" in on["start"]["result"]

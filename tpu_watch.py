@@ -28,6 +28,7 @@ import time
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _LOG = os.path.join(_HERE, "tpu_watch.log")
 _STATE = os.path.join(_HERE, ".tpu_queue_state.json")
+_EVENTS = os.path.join(_HERE, ".bench_events.jsonl")
 
 _PROBE_TIMEOUT = 110.0
 _PROBE_GAP = 330.0          # idle between failed probes (tunnel cooldown)
@@ -64,6 +65,19 @@ def _log(msg: str) -> None:
     print(line, flush=True)
     with open(_LOG, "a") as f:
         f.write(line + "\n")
+
+
+def _record_event(kind: str, **fields) -> None:
+    """Structured sibling of _log: machine-readable arm failures and
+    step kills, one JSON line each, for post-hoc triage (the human log
+    buries these between probe chatter)."""
+    record = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S%z"), "kind": kind}
+    record.update(fields)
+    try:
+        with open(_EVENTS, "a") as f:
+            f.write(json.dumps(record, sort_keys=True) + "\n")
+    except OSError as e:
+        _log(f"event record failed: {e}")
 
 
 def _load_state() -> dict:
@@ -121,13 +135,24 @@ def _probe() -> bool:
             pass
         proc.communicate()
         _log(f"probe: timeout after {_PROBE_TIMEOUT:.0f}s (tunnel wedged)")
+        _record_event("bench_arm_failed", attempted_backend="tpu",
+                      reason=f"probe timeout after {_PROBE_TIMEOUT:.0f}s",
+                      source="tpu_watch")
         return False
     for line in (out or "").splitlines():
         if line.startswith("PLATFORM="):
             plat = line.split("=", 1)[1]
             _log(f"probe: platform={plat}")
-            return plat not in ("cpu",)
+            if plat in ("cpu",):
+                _record_event("bench_arm_failed", attempted_backend="tpu",
+                              reason="only cpu visible to jax",
+                              source="tpu_watch")
+                return False
+            return True
     _log(f"probe: no platform line (rc={proc.returncode})")
+    _record_event("bench_arm_failed", attempted_backend="tpu",
+                  reason=f"no platform line (rc={proc.returncode})",
+                  source="tpu_watch")
     return False
 
 
@@ -173,6 +198,10 @@ def main() -> int:
                         state["attempts"][name] = (
                             state["attempts"].get(name, 0) + 1)
                         _save_state(state)
+                    else:
+                        _record_event("bench_step_killed", step=name,
+                                      deadline_s=deadline, wall_s=wall,
+                                      source="tpu_watch")
                     _log(f"{name}: rc={rc} after {wall}s "
                          f"(attempt {state['attempts'].get(name, 0)}/"
                          f"{_MAX_ATTEMPTS}); re-probing before retry")

@@ -144,6 +144,89 @@ def timed_reps(fn, seconds: float, max_reps: Optional[int] = None):
     return reps, time.perf_counter() - t0
 
 
+async def chain_with_utxo_fanout(n_fan: int, n_per: int, rng_key: int):
+    """3-block in-memory chain fanning one coinbase into n_fan x n_per
+    spendable leaf outputs — shared scaffolding for the bench_suite
+    accept/intake configs and the loadgen funded-wallet fixture.
+    Returns (state, manager, d, pub, addr, mids, mine_block) where
+    ``mine_block(txs)`` accepts one more block and returns its accept
+    seconds.  Mutates process-global difficulty/clock state; callers
+    must ``clock.reset()`` when done (bench configs and the loadgen
+    harness both do)."""
+    import time
+    from decimal import Decimal
+
+    from .core import clock, curve, difficulty, point_to_string
+    from .core.header import BlockHeader
+    from .core.merkle import merkle_root
+    from .core.tx import Tx, TxInput, TxOutput
+    from .mine.engine import MiningJob, mine
+    from .state import ChainState
+    from .verify import BlockManager
+
+    difficulty.START_DIFFICULTY = Decimal("1.0")
+    genesis_prev = (18_884_643).to_bytes(32, "little").hex()
+
+    state = ChainState()
+    manager = BlockManager(state)
+    d, pub = curve.keygen(rng=rng_key)
+    addr = point_to_string(pub)
+    pub_of = lambda _i: pub  # noqa: E731
+
+    async def mine_block(txs):
+        clock.advance(60)
+        diff, last = await manager.calculate_difficulty()
+        prev = last["hash"] if last else genesis_prev
+        header = BlockHeader(
+            previous_hash=prev, address=addr, merkle_root=merkle_root(txs),
+            timestamp=clock.timestamp(), difficulty_x10=int(diff * 10),
+            nonce=0)
+        if last:
+            r = mine(MiningJob(header.prefix_bytes(), prev, diff),
+                     "python", batch=1 << 14, ttl=600)
+            header.nonce = r.nonce
+        errors = []
+        t0 = time.perf_counter()
+        ok = await manager.create_block(header.hex(), txs, errors=errors)
+        dt = time.perf_counter() - t0
+        assert ok, errors
+        return dt
+
+    await mine_block([])                      # block 1: coinbase to addr
+    coin = (await state.get_spendable_outputs(addr))[0]
+    reward = coin.amount
+
+    per = reward // n_fan
+    outs = [TxOutput(addr, per)] * (n_fan - 1)
+    outs = outs + [TxOutput(addr, reward - per * (n_fan - 1))]
+    fan = Tx([coin], outs).sign([d], pub_of)
+    await mine_block([fan])
+
+    mids = []
+    for j in range(n_fan):
+        amt = fan.outputs[j].amount
+        sub = amt // n_per
+        souts = [TxOutput(addr, sub)] * (n_per - 1)
+        souts = souts + [TxOutput(addr, amt - sub * (n_per - 1))]
+        mids.append(Tx([TxInput(fan.hash(), j)], souts).sign([d], pub_of))
+    await mine_block(mids)
+    return state, manager, d, pub, addr, mids, mine_block
+
+
+def leaf_spends(parents, addr, d, pub):
+    """One 1-in-1-out spend per output of each parent tx (the bench
+    and loadgen push_tx payload generator)."""
+    from .core.tx import Tx, TxInput, TxOutput
+
+    out = []
+    for m in parents:
+        h = m.hash()
+        for k, o in enumerate(m.outputs):
+            out.append(Tx([TxInput(h, k)], [TxOutput(addr, o.amount)])
+                       .sign([d], lambda _i: pub))
+    return out
+
+
 def pipelined_loop(dispatch, finalize, seconds: float, depth: int = 2):
     """Keep up to ``depth`` async dispatches in flight until the deadline,
     then drain.  Returns (completed_rounds, elapsed) — elapsed includes

@@ -230,6 +230,22 @@ class TelemetryConfig:
 
 
 @dataclass
+class ProfilingConfig:
+    """Opt-in performance capture (upow_tpu/profiling/) — all off by
+    default; overridable as ``UPOW_PROFILE_<FIELD>``."""
+
+    enabled: bool = False           # serve /debug/profile (also requires
+                                    # telemetry.debug_endpoints)
+    trace_dir: str = "logs/jax_traces"  # xprof capture output directory
+    max_capture_seconds: float = 120.0  # auto-stop: a capture left
+                                    # running past this is closed on the
+                                    # next /debug/profile touch
+    cost_analysis: bool = False     # record compiled.cost_analysis()
+                                    # FLOPs/bytes next to the
+                                    # compile-cache counters
+
+
+@dataclass
 class Config:
     device: DeviceConfig = field(default_factory=DeviceConfig)
     node: NodeConfig = field(default_factory=NodeConfig)
@@ -239,6 +255,7 @@ class Config:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    profile: ProfilingConfig = field(default_factory=ProfilingConfig)
 
     @classmethod
     def load(cls, path: Optional[str] = None, **overrides) -> "Config":
@@ -279,7 +296,7 @@ def _merge_dict(cfg: Config, data: dict) -> Config:
 
 def _merge_env(cfg: Config) -> Config:
     for section in ("device", "node", "ws", "miner", "log", "resilience",
-                    "mempool", "telemetry"):
+                    "mempool", "telemetry", "profile"):
         sub = getattr(cfg, section)
         for f in dataclasses.fields(sub):
             env = f"UPOW_{section.upper()}_{f.name.upper()}"

@@ -1,0 +1,25 @@
+"""Deterministic wallet-population load generator (ROADMAP item 4).
+
+Drives an in-process node with a seeded, realistic request mix —
+Zipfian hot-account balance/UTXO reads, miner ``get_mining_info``
+polling, push_tx bursts through the coalescing intake, and WebSocket
+subscriber churn — and records per-endpoint req/s plus p50/p95/p99
+latency, both client-side (exact quantiles in the run summary) and
+server-side (``slo.http.*`` histograms on ``/metrics``).
+
+Layout (import-light on purpose: :mod:`.gate` must run with stdlib
+only, and ``python -m upow_tpu.loadgen.gate`` imports this package):
+
+* :mod:`.population` — seeded schedule builder (stdlib only).
+* :mod:`.runner`     — schedule execution + summary (stdlib + asyncio);
+  includes the deterministic mock backend the tests pin.
+* :mod:`.harness`    — the real in-process node target (aiohttp).
+* :mod:`.observatory` — merged SLO + kernel-bench artifact with
+  capture provenance; ``python -m upow_tpu.loadgen`` entry point.
+* :mod:`.gate`       — stdlib regression checker
+  (``python -m upow_tpu.loadgen.gate --against BENCH_r05.json``).
+"""
+
+from .population import LoadEvent, PopulationSpec, build_schedule  # noqa: F401
+
+__all__ = ["LoadEvent", "PopulationSpec", "build_schedule"]

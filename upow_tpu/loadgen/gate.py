@@ -1,0 +1,184 @@
+"""Bench regression gate (stdlib only — runs without jax/aiohttp).
+
+    python -m upow_tpu.loadgen.gate --against BENCH_r05.json \\
+        [--current observatory.json] [--tolerance 0.25] [--report-only]
+
+Flattens both sides into ``{metric: value}`` — understanding the
+driver's BENCH capture wrapper (``{n, cmd, rc, tail, parsed}``),
+bench.py single lines (with nested ``verify`` / ``native_cpu_allcores``
+sub-metrics), bench_suite JSON-line streams, and observatory artifacts
+(``slo.endpoints`` + ``kernels``) — then compares every metric present
+on BOTH sides.
+
+Direction is inferred from the name: latency-like metrics
+(``*_ms``, ``p50/p95/p99``, ``*latency*``, ``*seconds*``) regress
+upward, throughput metrics regress downward.  A metric regresses when
+it is worse than baseline by more than ``--tolerance`` (relative).
+
+Exit codes: 0 ok / report-only, 1 regression(s), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+DEFAULT_TOLERANCE = 0.25
+
+_LOWER_BETTER_TOKENS = ("_ms", "latency", "p50", "p95", "p99",
+                        "seconds", "_errors")
+
+
+def lower_is_better(metric: str) -> bool:
+    m = metric.lower()
+    return any(tok in m for tok in _LOWER_BETTER_TOKENS)
+
+
+def _num(value) -> Optional[float]:
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        return None
+    return float(value)
+
+
+def flatten(doc: dict, prefix: str = "") -> Dict[str, float]:
+    """Extract comparable metrics from any of the known artifact
+    shapes.  Unknown keys are ignored, never guessed at."""
+    out: Dict[str, float] = {}
+    if not isinstance(doc, dict):
+        return out
+
+    # driver capture wrapper: the real content lives under "parsed"
+    if isinstance(doc.get("parsed"), dict):
+        out.update(flatten(doc["parsed"], prefix))
+
+    # bench.py / bench_suite line: {"metric": ..., "value": ...}
+    metric, value = doc.get("metric"), _num(doc.get("value"))
+    if isinstance(metric, str) and value is not None:
+        out[prefix + metric] = value
+    for key in ("verify", "native_cpu_allcores"):
+        sub = doc.get(key)
+        if isinstance(sub, dict):
+            sub_metric = sub.get("metric", key)
+            sub_value = _num(sub.get("value"))
+            if sub_value is not None:
+                out[prefix + str(sub_metric)] = sub_value
+
+    # observatory artifact
+    slo = doc.get("slo")
+    if isinstance(slo, dict):
+        for ep, row in (slo.get("endpoints") or {}).items():
+            if not isinstance(row, dict):
+                continue
+            for field in ("req_s", "p50_ms", "p95_ms", "p99_ms"):
+                v = _num(row.get(field))
+                if v is not None:
+                    out[f"{prefix}slo.{ep}.{field}"] = v
+    kernels = doc.get("kernels")
+    if isinstance(kernels, dict):
+        for name, entry in kernels.items():
+            if name == "last_good_tpu":
+                continue  # stale snapshots must not gate a live run
+            v = _num(entry.get("value")) if isinstance(entry, dict) \
+                else _num(entry)
+            if v is not None:
+                out[f"{prefix}kernel.{name}"] = v
+    return out
+
+
+def load_metrics(path: str) -> Dict[str, float]:
+    """Flatten a file that is one JSON document or a JSON-line stream
+    (bench_suite output); later lines win on metric collisions."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        return flatten(json.loads(text))
+    except ValueError:
+        out: Dict[str, float] = {}
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.update(flatten(json.loads(line)))
+            except ValueError:
+                continue  # interleaved log noise
+        return out
+
+
+def compare(baseline: Dict[str, float], current: Dict[str, float],
+            tolerance: float) -> List[dict]:
+    """Per-common-metric verdicts, regressions first."""
+    rows = []
+    for metric in sorted(set(baseline) & set(current)):
+        base, cur = baseline[metric], current[metric]
+        lower = lower_is_better(metric)
+        if base == 0:
+            regressed = lower and cur > 0 and tolerance < 1
+            ratio = None
+        else:
+            ratio = cur / base
+            regressed = (ratio > 1 + tolerance if lower
+                         else ratio < 1 - tolerance)
+        rows.append({"metric": metric, "baseline": base, "current": cur,
+                     "ratio": round(ratio, 4) if ratio is not None else None,
+                     "direction": "lower" if lower else "higher",
+                     "regressed": regressed})
+    rows.sort(key=lambda r: (not r["regressed"], r["metric"]))
+    return rows
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m upow_tpu.loadgen.gate",
+        description="Fail when a metric regresses beyond tolerance.")
+    ap.add_argument("--against", required=True,
+                    help="baseline artifact (BENCH_r*.json, bench_suite "
+                         "stream, or observatory.json)")
+    ap.add_argument("--current", default="observatory.json",
+                    help="current artifact (default: observatory.json)")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="relative band before a worse value fails "
+                         f"(default {DEFAULT_TOLERANCE})")
+    ap.add_argument("--report-only", action="store_true",
+                    help="print verdicts but always exit 0")
+    args = ap.parse_args(argv)
+
+    try:
+        baseline = load_metrics(args.against)
+        current = load_metrics(args.current)
+    except OSError as e:
+        print(f"gate: cannot read artifact: {e}", file=sys.stderr)
+        return 2
+    if not baseline or not current:
+        print("gate: no metrics found in "
+              + (args.against if not baseline else args.current),
+              file=sys.stderr)
+        return 2
+
+    rows = compare(baseline, current, args.tolerance)
+    regressions = [r for r in rows if r["regressed"]]
+    report = {
+        "against": args.against, "current": args.current,
+        "tolerance": args.tolerance,
+        "compared": len(rows), "regressions": len(regressions),
+        "verdicts": rows,
+    }
+    print(json.dumps(report, indent=1, sort_keys=True))
+    if not rows:
+        print("gate: WARNING no overlapping metrics between artifacts",
+              file=sys.stderr)
+        return 0
+    if regressions and not args.report_only:
+        for r in regressions:
+            print(f"gate: REGRESSION {r['metric']}: "
+                  f"{r['baseline']} -> {r['current']} "
+                  f"({r['direction']} is better, tol {args.tolerance})",
+                  file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

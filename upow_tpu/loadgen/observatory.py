@@ -1,0 +1,200 @@
+"""The perf observatory: one artifact merging SLO + kernel metrics.
+
+``python -m upow_tpu.loadgen`` (and ``make perf-observatory`` /
+bench_suite config 11) runs the load generator against the in-process
+node, measures the cheap host-path kernel benches, and writes a single
+structured JSON artifact:
+
+* ``slo`` — per-endpoint req/s + p50/p95/p99 (client-measured, exact)
+  plus the node's own server-side histogram estimates.
+* ``kernels`` — host kernel rates (python / native search + verify)
+  and, when armed, the freshest persisted TPU capture.
+* ``provenance`` — what actually ran: ``backend``, ``platform``,
+  ``attempted_backend``, ``arm_failure_reason``.  BENCH_r02–r05 all
+  silently degraded to a scrubbed-env CPU child; this block is the
+  machine-readable record that it happened (or didn't).
+* optionally appended (``--progress``) to PROGRESS.jsonl so the
+  trajectory file carries SLO metrics alongside kernel throughput.
+
+The regression gate (:mod:`.gate`) consumes these artifacts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import json
+import os
+from typing import Optional
+
+from ..logger import get_logger
+from .population import PopulationSpec, build_schedule, schedule_fingerprint
+
+log = get_logger("loadgen")
+
+
+def kernel_bench(seconds: float = 0.4) -> dict:
+    """Cheap, always-available host kernel measurements (no XLA
+    compiles — CI smoke must stay fast): the pure-python reference
+    loops plus the native C++ paths when the extension is present."""
+    from .. import native
+    from ..benchutil import (python_loop_mhs, python_verify_rate,
+                             timed_reps, verify_fixture)
+
+    prefix = bytes(range(32)) * 2
+    out = {
+        "search_python_loop": {
+            "value": round(python_loop_mhs(prefix, seconds), 3),
+            "unit": "MH/s"},
+    }
+    digests, sigs, pubs, msgs = verify_fixture(512)
+    out["verify_python"] = {
+        "value": round(python_verify_rate(msgs, sigs, pubs, seconds), 1),
+        "unit": "sigs/s"}
+    if native.load() is not None:
+        first = native.p256_verify_batch(digests, sigs, pubs)  # warm
+        if first is not None and all(first):
+            reps, elapsed = timed_reps(
+                lambda: native.p256_verify_batch(digests, sigs, pubs),
+                seconds)
+            out["verify_native"] = {
+                "value": round(reps * len(digests) / elapsed, 1),
+                "unit": "sigs/s"}
+    return out
+
+
+def _arm_device(probe_timeout: float) -> dict:
+    """Try to arm a real accelerator; provenance either way, plus the
+    structured ``bench_arm_failed`` event on failure (satellite 1's
+    contract, shared with bench.py)."""
+    from .. import telemetry
+    from ..benchutil import probed_platform_cached
+
+    platform = probed_platform_cached(probe_timeout)
+    if platform is None:
+        reason = f"backend probe hung/failed after {probe_timeout:.0f}s"
+        telemetry.event("bench_arm_failed", reason=reason,
+                        attempted_backend="tpu", source="observatory")
+        return {"platform": None, "attempted_backend": "tpu",
+                "arm_failure_reason": reason}
+    if platform == "cpu":
+        reason = "only cpu visible to jax"
+        telemetry.event("bench_arm_failed", reason=reason,
+                        attempted_backend="tpu", source="observatory")
+        return {"platform": "cpu", "attempted_backend": "tpu",
+                "arm_failure_reason": reason}
+    return {"platform": platform, "attempted_backend": "tpu",
+            "arm_failure_reason": None}
+
+
+def _kernel_cost_analysis() -> Optional[dict]:
+    """Record the XLA cost analysis of the production jnp search
+    program at a small batch (compile on whatever backend is armed)."""
+    from .. import profiling
+    from ..core import curve, point_to_string
+    from ..core.header import BlockHeader
+    from ..core.merkle import merkle_root
+    from ..crypto import make_template, target_spec
+    from ..crypto import sha256 as sk
+
+    import jax.numpy as jnp
+
+    _, pub = curve.keygen(rng=0xBE7C)
+    header = BlockHeader(
+        previous_hash=bytes(range(32)).hex(), address=point_to_string(pub),
+        merkle_root=merkle_root([]), timestamp=1_753_791_000,
+        difficulty_x10=90, nonce=0)
+    template = make_template(header.prefix_bytes())
+    spec = target_spec(header.previous_hash, "9.0")
+    batch = 1 << 10
+    return profiling.analyze_cost(
+        f"sha256_pow_search_jnp_b{batch}", sk._pow_search_jnp,
+        jnp.asarray(template.midstate), jnp.asarray(template.tail_words),
+        jnp.uint32(0), batch, template.nonce_spec, spec)
+
+
+def run_observatory(spec: Optional[PopulationSpec] = None,
+                    bench_seconds: float = 0.4,
+                    device: bool = False,
+                    cost: bool = False,
+                    probe_timeout: float = 90.0) -> dict:
+    """Run loadgen + kernel benches; return the merged artifact."""
+    from .harness import run_against_node
+
+    spec = spec or PopulationSpec()
+    provenance = {"backend": "node-inprocess", "platform": "host",
+                  "attempted_backend": None, "arm_failure_reason": None}
+    if device:
+        provenance.update(_arm_device(probe_timeout))
+
+    load = asyncio.run(run_against_node(spec))
+    kernels = kernel_bench(bench_seconds)
+
+    if cost:
+        try:
+            analysis = _kernel_cost_analysis()
+            if analysis:
+                kernels["search_jnp_cost_analysis"] = {
+                    k: analysis[k] for k in sorted(analysis)[:8]}
+        except Exception as e:
+            log.warning("cost analysis skipped: %s", e)
+
+    try:
+        from bench import _load_last_good_tpu  # repo-root bench.py
+
+        last_good = _load_last_good_tpu()
+    except Exception as e:  # installed-package runs have no bench.py
+        log.debug("last_good_tpu snapshot unavailable: %s", e)
+        last_good = None
+    if last_good:
+        kernels["last_good_tpu"] = {
+            metric: {"value": entry.get("value"),
+                     "unit": entry.get("unit"),
+                     "measured_at": entry.get("measured_at")}
+            for metric, entry in last_good.items()}
+
+    artifact = {
+        "kind": "perf_observatory",
+        "schedule_fingerprint": schedule_fingerprint(build_schedule(spec)),
+        "population": spec.to_dict(),
+        "slo": {
+            "elapsed_s": load["elapsed_s"],
+            "events": load["events"],
+            "endpoints": load["endpoints"],
+            "server_estimates": load.get("server_slo", {}),
+        },
+        "ws": load.get("ws_hub", {}),
+        "kernels": kernels,
+        "provenance": provenance,
+    }
+    return artifact
+
+
+def write_artifact(artifact: dict, out_path: str) -> None:
+    tmp = f"{out_path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, out_path)
+
+
+def append_progress(artifact: dict, progress_path: str) -> None:
+    """One compact trajectory line per observatory run, additive to the
+    driver's own PROGRESS.jsonl records (distinguished by ``kind``)."""
+    line = {
+        "ts": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+        "kind": "perf_observatory",
+        "slo": {ep: {"req_s": row.get("req_s"),
+                     "p50_ms": row.get("p50_ms"),
+                     "p95_ms": row.get("p95_ms"),
+                     "p99_ms": row.get("p99_ms"),
+                     "errors": row.get("errors")}
+                for ep, row in artifact["slo"]["endpoints"].items()},
+        "kernels": {name: entry.get("value")
+                    for name, entry in artifact["kernels"].items()
+                    if isinstance(entry, dict) and "value" in entry},
+        "provenance": artifact["provenance"],
+    }
+    with open(progress_path, "a") as f:
+        f.write(json.dumps(line, sort_keys=True) + "\n")

@@ -92,10 +92,17 @@ class IntakeCoordinator:
 
     # ------------------------------------------------------------ entry ---
 
+    QUEUE_DEPTH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
     async def submit(self, tx, sender: Optional[str]) -> dict:
         """Queue one tx and wait for its wire-compatible result dict."""
         fut = asyncio.get_event_loop().create_future()
         self._queue.append(_Req(tx, sender, fut))
+        # admission-time backlog: how many requests each arrival found
+        # ahead of it (incl. itself) — the burst-coalescing depth the
+        # loadgen's push waves are designed to exercise
+        trace.observe("mempool.intake_queue_depth", len(self._queue),
+                      buckets=self.QUEUE_DEPTH_BUCKETS)
         self._ensure_drainer()
         return await fut
 

@@ -359,6 +359,11 @@ class Node:
         traced = self.config.telemetry.trace_requests and not (
             normalized in ("/metrics", "/ws")
             or normalized.startswith("/debug"))
+        # SLO latency capture: only registered routes (the fixed set
+        # built in _build_app) get a series — deriving names from raw
+        # paths would let a scanner consume the metric cardinality cap
+        slo_t0 = time.perf_counter() if normalized in self._slo_paths \
+            else None
         trace_id = None
         try:
             if traced:
@@ -373,15 +378,24 @@ class Node:
         except web.HTTPException:
             raise
         except _BadParam as e:
+            if slo_t0 is not None:
+                telemetry.slo.observe_request(
+                    normalized, time.perf_counter() - slo_t0, 422)
             return web.json_response(
                 {"ok": False, "error": f"Invalid integer parameter {e}"},
                 status=422)
         except Exception as e:  # exception envelope (main.py:394-406)
             log.error("Error on %s, %s: %s", request.path, type(e).__name__,
                       e, exc_info=True)
+            if slo_t0 is not None:
+                telemetry.slo.observe_request(
+                    normalized, time.perf_counter() - slo_t0, 500)
             return web.json_response(
                 {"ok": False, "error": f"Uncaught {type(e).__name__} exception"},
                 status=500)
+        if slo_t0 is not None:
+            telemetry.slo.observe_request(
+                normalized, time.perf_counter() - slo_t0, response.status)
         response.headers["Access-Control-Allow-Origin"] = "*"
         if trace_id is not None:
             response.headers[telemetry.TRACE_HEADER] = trace_id
@@ -601,6 +615,10 @@ class Node:
                     "Open WebSocket push connections")
             e.gauge("ws_messages_out", ws["messages_out"],
                     "WebSocket messages delivered")
+            e.counter("ws_connects_total", ws["connects_total"],
+                      "WebSocket connections accepted since start")
+            e.counter("ws_disconnects_total", ws["disconnects_total"],
+                      "WebSocket connections dropped since start")
         for state_name, count in sorted(self.breakers.state_counts().items()):
             e.gauge(f"breaker_{state_name}_peers", count,
                     f"Peers whose circuit breaker is {state_name}")
@@ -615,6 +633,12 @@ class Node:
             for key, value in sorted(mem.items()):
                 e.gauge(f"device_{label}_{key}", value,
                         "Best-effort device memory_stats() value")
+        # XLA cost-analysis estimates (upow_tpu/profiling.analyze_cost),
+        # next to the compile-cache counters they contextualize
+        for kern, costs in sorted(telemetry.device.cost_estimates().items()):
+            for key, value in sorted(costs.items()):
+                e.gauge(f"kernel_{kern}_cost_{key}", value,
+                        "XLA compiled.cost_analysis() estimate")
         for name, value in sorted(trace.counters().items()):
             e.counter(name, value)
         for name, s in sorted(trace.stats().items()):
@@ -628,22 +652,78 @@ class Node:
         resp.headers["Content-Type"] = telemetry.exposition.CONTENT_TYPE
         return resp
 
+    # cap on debug ``limit`` params: far above any configurable ring
+    # size, so a clamped value never truncates a legitimate request
+    _DEBUG_LIMIT_CAP = 100_000
+
+    @classmethod
+    def _debug_limit(cls, params, default: int = 0):
+        """Parse a debug endpoint's ``limit``: (value, None) or
+        (None, 400 response).  Unlike ``_int_q`` (422 via middleware),
+        debug endpoints answer bad input directly with a 400 — they are
+        operator surface, not reference wire surface.  Negative values
+        clamp to 0 (= everything) and oversized ones to the cap, so no
+        raw user integer ever reaches a slice."""
+        raw = params.get("limit")
+        if raw is None or raw == "":
+            return default, None
+        try:
+            value = int(raw)
+        except ValueError:
+            return None, web.json_response(
+                {"ok": False, "error": "limit must be an integer"},
+                status=400)
+        return max(0, min(value, cls._DEBUG_LIMIT_CAP)), None
+
     async def h_debug_traces(self, request: web.Request) -> web.Response:
         """Completed trace trees: recency ring + slowest top-N
-        (telemetry/tracing.py TraceBuffer)."""
-        return web.json_response({"ok": True,
-                                  "result": telemetry.traces()})
+        (telemetry/tracing.py TraceBuffer).  ``limit`` bounds both
+        lists (0 = all)."""
+        limit, err = self._debug_limit(request.rel_url.query)
+        if err is not None:
+            return err
+        result = telemetry.traces()
+        if limit:
+            result = {"recent": result.get("recent", [])[-limit:],
+                      "slowest": result.get("slowest", [])[:limit]}
+        return web.json_response({"ok": True, "result": result})
 
     async def h_debug_events(self, request: web.Request) -> web.Response:
         """Structured event ring: reorgs, breaker trips, degrade
         transitions, fault injections — oldest first, each stamped with
         the trace ID active when it fired."""
         params = request.rel_url.query
-        limit = _int_q(params, "limit", 0) or None
+        limit, err = self._debug_limit(params)
+        if err is not None:
+            return err
         kind = params.get("kind")
         return web.json_response({
             "ok": True,
-            "result": telemetry.events.snapshot(limit=limit, kind=kind)})
+            "result": telemetry.events.snapshot(limit=limit or None,
+                                                kind=kind)})
+
+    async def h_debug_profile(self, request: web.Request) -> web.Response:
+        """Opt-in jax.profiler capture control (ProfilingConfig):
+        ``?action=start|stop|status``.  Route exists only when both
+        telemetry.debug_endpoints and profile.enabled say so."""
+        from .. import profiling
+
+        pcfg = self.config.profile
+        action = request.rel_url.query.get("action", "status")
+        if action == "start":
+            result = profiling.start(pcfg.trace_dir,
+                                     pcfg.max_capture_seconds)
+        elif action == "stop":
+            result = profiling.stop()
+        elif action == "status":
+            result = profiling.status()
+        else:
+            return web.json_response(
+                {"ok": False,
+                 "error": "action must be start, stop or status"},
+                status=400)
+        return web.json_response({"ok": "error" not in result,
+                                  "result": result})
 
     async def h_push_tx(self, request: web.Request) -> web.Response:
         if self.is_syncing:
@@ -1519,11 +1599,22 @@ class Node:
         if self.config.telemetry.debug_endpoints:
             r.add_get("/debug/traces", self.h_debug_traces)
             r.add_get("/debug/events", self.h_debug_events)
+            if self.config.profile.enabled:
+                r.add_get("/debug/profile", self.h_debug_profile)
         if self.config.ws.enabled:
             from ..ws.hub import WsHub
 
             self.ws_hub = WsHub(self.config.ws)
             r.add_get("/ws", self.ws_hub.handle)
+        # SLO latency series for the fixed route set (not /ws — a
+        # socket's "latency" is its lifetime — and not /debug/*, which
+        # would meter the metering).  Preregistered so every endpoint
+        # exports an all-zero family from scrape #1.
+        self._slo_paths = {
+            res.canonical for res in r.resources()
+            if res.canonical.startswith("/")
+            and not res.canonical.startswith(("/ws", "/debug"))}
+        telemetry.slo.preregister(self._slo_paths)
         return app
 
 

@@ -24,7 +24,7 @@ from contextlib import contextmanager
 from typing import Optional
 
 from ..logger import get_logger
-from . import device, events, exposition, metrics, tracing
+from . import device, events, exposition, metrics, slo, tracing
 from .events import emit as event
 from .metrics import (counters, ensure_counter, ensure_histogram,  # noqa: F401
                       histograms, inc, observe, stats)
@@ -43,7 +43,7 @@ __all__ = [
     "ensure_counter", "ensure_histogram", "event", "events",
     "exposition", "finish_child", "histograms", "inc", "metrics",
     "new_trace_id", "observe", "profile", "request_trace", "reset",
-    "span", "stats", "traces", "tracing", "valid_trace_id",
+    "slo", "span", "stats", "traces", "tracing", "valid_trace_id",
 ]
 
 

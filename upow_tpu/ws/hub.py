@@ -99,6 +99,11 @@ class WsHub:
         self.by_ip: Dict[str, Set[str]] = {}
         self.channels: Dict[str, Set[str]] = {c: set() for c in self.cfg.channels}
         self._loops_started = False
+        # cumulative lifecycle counters: get_stats() sums over LIVE
+        # connections only, so subscriber churn (the loadgen's ws
+        # scenario) was invisible before these
+        self.connects_total = 0
+        self.disconnects_total = 0
 
     # ------------------------------------------------------------ endpoint --
     async def handle(self, request: web.Request) -> web.WebSocketResponse:
@@ -117,6 +122,7 @@ class WsHub:
         conn = WsConnection(ws, ip, self.cfg)
         self.connections[conn.id] = conn
         self.by_ip.setdefault(ip, set()).add(conn.id)
+        self.connects_total += 1
         self._ensure_loops()
         log.info("ws connect %s from %s (%d total)", conn.id, ip,
                  len(self.connections))
@@ -179,7 +185,10 @@ class WsHub:
                               f"Message type '{mtype}' not allowed")
 
     def _drop(self, conn: WsConnection) -> None:
-        self.connections.pop(conn.id, None)
+        if self.connections.pop(conn.id, None) is not None:
+            # count once even when the reap path and the handler's
+            # finally both drop the same connection
+            self.disconnects_total += 1
         self.by_ip.get(conn.ip, set()).discard(conn.id)
         if not self.by_ip.get(conn.ip):
             self.by_ip.pop(conn.ip, None)
@@ -249,6 +258,8 @@ class WsHub:
             "channels": {c: len(m) for c, m in self.channels.items()},
             "messages_out": sum(c.messages_out for c in self.connections.values()),
             "messages_in": sum(c.messages_in for c in self.connections.values()),
+            "connects_total": self.connects_total,
+            "disconnects_total": self.disconnects_total,
         }
 
     def get_detailed_stats(self) -> dict:
