@@ -37,7 +37,11 @@ perf-observatory:
 	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.loadgen \
 		--out observatory.json --progress PROGRESS.jsonl
 
-# CI-sized variant: tiny population, no PROGRESS append.
+# CI-sized variant: tiny population, no PROGRESS append.  Gates
+# (report-only) against the committed artifact so every metric —
+# including verify_pipeline with its explicit higher-is-better
+# direction — is registered with gate.py on each smoke run.
 perf-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.loadgen --smoke \
-		--out observatory-smoke.json
+		--out observatory-smoke.json \
+		--against observatory.json --report-only

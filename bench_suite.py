@@ -16,6 +16,8 @@ configs, one JSON line each.
 9. end-to-end HTTP chain sync, wire to state (cold catch-up)
 10. coalesced push_tx waves through the micro-batching intake
 11. perf observatory: wallet-population loadgen SLO + kernel artifact
+12. verify_pipeline: pipelined verify engine (coalesced front + verdict
+    cache, steady state) vs serial per-tx host dispatch + differential
 
 ``bench.py`` stays the driver-facing single-line headline (sha256
 search + the verify sub-metric); this suite is the full scoreboard.
@@ -543,6 +545,25 @@ def config11_perf_observatory(seconds: float):
         _emit(f"slo_{ep}_p95", row["p95_ms"], "ms", None)
 
 
+def config12_verify_pipeline(seconds: float):
+    """Pipelined block-verify engine vs the serial per-tx dispatch on
+    the SAME host backend (ISSUE 7 acceptance): micro-batched
+    submissions coalesced through the shared dispatch front with the
+    verdict cache live (steady-state gossip profile) against one
+    cache-bypassed ``verify_batch_native_cpu``-path call per tx.  The
+    bench asserts byte-identical accept/reject verdicts between the two
+    paths over >=1k mixed valid/invalid signatures before emitting."""
+    from upow_tpu.benchutil import verify_pipeline_bench
+
+    r = verify_pipeline_bench(seconds=min(seconds / 4, 1.0))
+    assert r["verdicts_equal"], \
+        "pipelined verdicts diverged from the serial path"
+    _emit(f"verify_pipeline_{_platform()}", r["pipelined_tx_s"], "tx/s",
+          r["serial_tx_s"])
+    _emit(f"verify_pipeline_serial_{_platform()}", r["serial_tx_s"],
+          "tx/s", None)
+
+
 def config9_sync(seconds: float):
     """End-to-end chain sync over real localhost HTTP: node B downloads
     node A's chain in pages (prefetch pipeline, page-level signature
@@ -681,6 +702,7 @@ def main() -> int:
         "9": lambda: config9_sync(args.seconds),
         "10": lambda: config10_coalesced_intake(args.seconds),
         "11": lambda: config11_perf_observatory(args.seconds),
+        "12": lambda: config12_verify_pipeline(args.seconds),
     }
     needs_device = {"2", "3", "5", "7"}
     failed = []

@@ -1,9 +1,11 @@
-"""DeviceUtxoIndex: prefilter semantics, multiset collision safety
-(upow_tpu/state/device_index.py; SURVEY §2.2, VERDICT weak #5)."""
+"""DeviceUtxoIndex: exact membership, twin-fingerprint safety, batched
+fingerprinting, incremental sorted maintenance
+(upow_tpu/state/device_index.py; SURVEY §2.2, ISSUE 7 tentpole a)."""
 
 import numpy as np
 
-from upow_tpu.state.device_index import DeviceUtxoIndex, fingerprint
+from upow_tpu.state.device_index import (DeviceUtxoIndex, fingerprint,
+                                         fingerprint_batch)
 
 
 def _op(i: int, idx: int = 0):
@@ -22,38 +24,99 @@ def test_prefilter_membership_and_updates():
     assert len(idx) == 2
 
 
+def test_exact_membership_no_escalation():
+    """contains_batch answers exactly — the SQL escalation the old
+    prefilter needed is gone from the hot path."""
+    ops = [_op(i) for i in range(64)]
+    idx = DeviceUtxoIndex(ops[:32])
+    mask = idx.contains_batch(ops)
+    assert mask[:32].all() and not mask[32:].any()
+    # same txid, different output index: distinct outpoints
+    assert list(idx.contains_batch([(ops[0][0], 0), (ops[0][0], 1)])) == \
+        [True, False]
+
+
 def test_empty_and_large_batches():
     idx = DeviceUtxoIndex()
     assert idx.maybe_contains_batch([]).shape == (0,)
+    assert idx.contains_batch([]).shape == (0,)
     ops = [_op(i) for i in range(1000)]
     idx.add(ops)
-    mask = idx.maybe_contains_batch(ops + [_op(10_000)])
+    mask = idx.contains_batch(ops + [_op(10_000)])
     assert mask[:1000].all() and not mask[1000]
 
 
 def test_collision_twin_not_over_removed(monkeypatch):
-    """Two live outpoints sharing a fingerprint: spending one must NOT
-    make the prefilter report the survivor as definitely absent (that
-    would reject a valid block)."""
+    """Two live outpoints sharing a 64-bit fingerprint: spending one must
+    NOT make the survivor report absent (that would reject a valid
+    block).  The exact map resolves the twins individually."""
     import upow_tpu.state.device_index as di
 
-    monkeypatch.setattr(di, "fingerprint", lambda o: 42)  # force collision
+    monkeypatch.setattr(  # force a universal collision
+        di, "fingerprint_batch",
+        lambda ops: np.full(len(ops), 42, dtype=np.uint64))
     idx = di.DeviceUtxoIndex([_op(1), _op(2)])
     idx.remove([_op(1)])
-    # the survivor still fingerprint-hits (escalation decides exactness)
+    # the survivor is still exactly present; the spent twin is not
+    assert list(idx.contains_batch([_op(2)])) == [True]
+    assert list(idx.contains_batch([_op(1)])) == [False]
+    # the prefilter still hits on the shared fingerprint (sound: it only
+    # promises that False is definitive absence)
     assert list(idx.maybe_contains_batch([_op(2)])) == [True]
     idx.remove([_op(2)])
+    assert list(idx.contains_batch([_op(2)])) == [False]
     assert list(idx.maybe_contains_batch([_op(2)])) == [False]
+    assert len(idx) == 0
 
 
-def test_fingerprint_is_stable_and_signed32():
+def test_fingerprint_is_stable_uint64_and_batch_identical():
     fp = fingerprint(_op(7, 3))
     assert fp == fingerprint(_op(7, 3))
-    assert -(1 << 31) <= fp < (1 << 31)
+    assert 0 <= fp < (1 << 64)
     assert fingerprint(_op(7, 4)) != fp
+    ops = [_op(i, i % 5) for i in range(200)]
+    batch = fingerprint_batch(ops)
+    assert batch.dtype == np.uint64
+    assert batch.tolist() == [fingerprint(o) for o in ops]
 
 
 def test_remove_absent_outpoint_is_noop():
     idx = DeviceUtxoIndex([_op(1)])
     idx.remove([_op(99)])  # matches the SQL DELETE / old set semantics
-    assert list(idx.maybe_contains_batch([_op(1), _op(99)])) == [True, False]
+    assert list(idx.contains_batch([_op(1), _op(99)])) == [True, False]
+    assert len(idx) == 1
+
+
+def test_incremental_insert_keeps_keys_sorted():
+    """add() splices sorted slabs into place — no full re-sort — and the
+    host key array must stay sorted through interleaved adds/removes
+    (searchsorted correctness depends on it)."""
+    idx = DeviceUtxoIndex([_op(i) for i in range(0, 100, 2)])
+    idx.add([_op(i) for i in range(1, 100, 2)])
+    assert (np.diff(idx._host_keys.astype(np.uint64)) >= 0).all()
+    idx.remove([_op(i) for i in range(0, 100, 3)])
+    assert (np.diff(idx._host_keys.astype(np.uint64)) >= 0).all()
+    expect = {i for i in range(100)} - set(range(0, 100, 3))
+    mask = idx.contains_batch([_op(i) for i in range(100)])
+    assert {i for i in range(100) if mask[i]} == expect
+
+
+def test_apply_block_and_reorg_rollback_roundtrip():
+    """Block accept applies (created, spent) in one batched call; a reorg
+    rollback applies the inverse and must restore the exact pre-block
+    membership, twins included."""
+    genesis = [_op(i) for i in range(16)]
+    idx = DeviceUtxoIndex(genesis)
+    before = idx.contains_batch(genesis + [_op(100), _op(101)]).tolist()
+
+    created = [_op(100), _op(101)]
+    spent = [_op(0), _op(1), _op(2)]
+    idx.apply_block(created, spent)
+    assert list(idx.contains_batch(spent)) == [False, False, False]
+    assert list(idx.contains_batch(created)) == [True, True]
+
+    # rollback: the spent set is re-created, the created set removed
+    idx.apply_block(spent, created)
+    after = idx.contains_batch(genesis + [_op(100), _op(101)]).tolist()
+    assert after == before
+    assert len(idx) == len(genesis)

@@ -131,6 +131,102 @@ def python_verify_rate(msgs, sigs, pubs, seconds: float = 1.0) -> float:
     return n / (time.perf_counter() - t0)
 
 
+def pipeline_verify_fixture(n_txs: int, n_unique: int = 128,
+                            invalid_every: int = 13, rng_base: int = 9100):
+    """Per-tx signature-check tuples (the txverify check shape:
+    ``(digest, digest_hexform, sig, pub)``) with a deterministic mix of
+    valid and invalid signatures — every ``invalid_every``-th check
+    carries a corrupted ``s``, which fails BOTH verify passes (raw and
+    hex-form digest) exactly like a forged wire signature would.
+    ``n_unique`` keypairs/messages tiled to ``n_txs``, bench-cheap like
+    :func:`verify_fixture`."""
+    from .core import curve
+
+    base = []
+    for i in range(n_unique):
+        d, pub = curve.keygen(rng=rng_base + i)
+        m = (b"vp" + i.to_bytes(4, "big")) * 6
+        digest = hashlib.sha256(m).digest()
+        hexform = hashlib.sha256(m.hex().encode()).digest()
+        base.append((digest, hexform, curve.sign(m, d), pub))
+    checks = []
+    for i in range(n_txs):
+        digest, hexform, (r, s), pub = base[i % n_unique]
+        if invalid_every and i % invalid_every == 0:
+            s = s - 1 if s > 1 else s + 1
+        checks.append((digest, hexform, (r, s), pub))
+    return checks
+
+
+def verify_pipeline_bench(seconds: float = 0.4, n_txs: int = 1024,
+                          microbatch: int = 128) -> dict:
+    """The ``verify_pipeline`` bench (ISSUE 7): pipelined engine vs the
+    serial per-tx dispatch, same host backend, with a built-in
+    differential check.
+
+    * ``serial`` — one cache-bypassed ``run_sig_checks`` call per tx
+      (the reference's profile: every hop re-verifies every signature
+      through the same ``verify_batch_native_cpu`` host path, one tx at
+      a time).
+    * ``pipelined`` — micro-batched submissions coalesced through the
+      shared dispatch front (verify/dispatch.py) with the verdict cache
+      live, sustained over ``seconds`` after one cold populate pass —
+      the engine's steady-state gossip profile, where block accept
+      re-verifies intake-verified txs.  The cold pass computes every
+      verdict through the identical host path, so the cache can never
+      answer something the serial path would not.
+
+    Returns serial/pipelined tx-verify/s, their ratio, and the
+    differential verdict comparison over all ``n_txs`` checks (serial
+    vs cold pipelined vs warm pipelined must be identical lists).
+    """
+    import asyncio
+
+    from .verify import txverify
+    from .verify.dispatch import get_front
+
+    checks = pipeline_verify_fixture(n_txs)
+
+    # serial reference: per-tx dispatch, no cache
+    txverify.clear_sig_verdicts()
+    t0 = time.perf_counter()
+    serial_verdicts: list = []
+    for c in checks:
+        serial_verdicts.extend(txverify.run_sig_checks(
+            [c], backend="host", use_cache=False))
+    serial_rate = n_txs / (time.perf_counter() - t0)
+
+    async def one_pass():
+        front = get_front()
+        outs = await asyncio.gather(*[
+            front.submit(checks[i:i + microbatch], backend="host",
+                         source="bench")
+            for i in range(0, n_txs, microbatch)])
+        return [v for out in outs for v in out]
+
+    async def pipelined():
+        txverify.clear_sig_verdicts()
+        cold = await one_pass()  # intake populate pass, untimed
+        t0 = time.perf_counter()
+        reps, warm = 0, cold
+        while time.perf_counter() - t0 < seconds:
+            warm = await one_pass()
+            reps += 1
+        elapsed = time.perf_counter() - t0
+        return cold, warm, (reps * n_txs / elapsed) if reps else 0.0
+
+    cold_verdicts, warm_verdicts, pipe_rate = asyncio.run(pipelined())
+    equal = serial_verdicts == cold_verdicts == warm_verdicts
+    return {
+        "serial_tx_s": round(serial_rate, 1),
+        "pipelined_tx_s": round(pipe_rate, 1),
+        "speedup": round(pipe_rate / serial_rate, 2) if serial_rate else None,
+        "differential_txs": n_txs,
+        "verdicts_equal": equal,
+        "n_invalid": sum(1 for v in serial_verdicts if not v),
+    }
+
+
 def timed_reps(fn, seconds: float, max_reps: Optional[int] = None):
     """Repeat ``fn`` until the deadline (or ``max_reps``); returns
     (reps, elapsed).  The shared timed-loop plumbing for synchronous

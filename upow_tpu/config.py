@@ -47,6 +47,10 @@ class DeviceConfig:
                                     # auto resolves by measuring both
                                     # once per process
     txid_min_batch: int = 256       # below this, always hashlib
+    verify_microbatch: int = 1024   # txs per check_block micro-batch:
+                                    # digest prep of batch N overlaps the
+                                    # in-flight sig verify of batch N-1
+                                    # (verify/block.py); 0 = whole block
 
     def resolve_search_backend(self, platform: str) -> str:
         if self.search_backend != "auto":

@@ -10,10 +10,15 @@ sub-metrics), bench_suite JSON-line streams, and observatory artifacts
 (``slo.endpoints`` + ``kernels``) — then compares every metric present
 on BOTH sides.
 
-Direction is inferred from the name: latency-like metrics
-(``*_ms``, ``p50/p95/p99``, ``*latency*``, ``*seconds*``) regress
-upward, throughput metrics regress downward.  A metric regresses when
-it is worse than baseline by more than ``--tolerance`` (relative).
+Direction: a metric entry may carry an explicit
+``"direction": "higher" | "lower"`` in the artifact (kernel entries and
+bench lines), which always wins.  Otherwise direction is inferred from
+the name: latency-like metrics (``*_ms``, ``p50/p95/p99``,
+``*latency*``, ``*seconds*``) regress upward, throughput metrics
+regress downward — name inference is ambiguous for names like
+``verify_pipeline_speedup`` vs ``dispatch_seconds``, which is exactly
+what the explicit override exists for.  A metric regresses when it is
+worse than baseline by more than ``--tolerance`` (relative).
 
 Exit codes: 0 ok / report-only, 1 regression(s), 2 usage error.
 """
@@ -36,27 +41,42 @@ def lower_is_better(metric: str) -> bool:
     return any(tok in m for tok in _LOWER_BETTER_TOKENS)
 
 
+def _note_direction(directions: Optional[Dict[str, str]], name: str,
+                    entry) -> None:
+    """Record an entry's explicit ``direction`` field, if present and
+    well-formed (anything else keeps name inference)."""
+    if directions is None or not isinstance(entry, dict):
+        return
+    d = entry.get("direction")
+    if d in ("higher", "lower"):
+        directions[name] = d
+
+
 def _num(value) -> Optional[float]:
     if isinstance(value, bool) or not isinstance(value, (int, float)):
         return None
     return float(value)
 
 
-def flatten(doc: dict, prefix: str = "") -> Dict[str, float]:
+def flatten(doc: dict, prefix: str = "",
+            directions: Optional[Dict[str, str]] = None) -> Dict[str, float]:
     """Extract comparable metrics from any of the known artifact
-    shapes.  Unknown keys are ignored, never guessed at."""
+    shapes.  Unknown keys are ignored, never guessed at.  When a
+    ``directions`` dict is passed, explicit per-metric ``direction``
+    fields found in the artifact are collected into it."""
     out: Dict[str, float] = {}
     if not isinstance(doc, dict):
         return out
 
     # driver capture wrapper: the real content lives under "parsed"
     if isinstance(doc.get("parsed"), dict):
-        out.update(flatten(doc["parsed"], prefix))
+        out.update(flatten(doc["parsed"], prefix, directions))
 
     # bench.py / bench_suite line: {"metric": ..., "value": ...}
     metric, value = doc.get("metric"), _num(doc.get("value"))
     if isinstance(metric, str) and value is not None:
         out[prefix + metric] = value
+        _note_direction(directions, prefix + metric, doc)
     for key in ("verify", "native_cpu_allcores"):
         sub = doc.get(key)
         if isinstance(sub, dict):
@@ -64,6 +84,7 @@ def flatten(doc: dict, prefix: str = "") -> Dict[str, float]:
             sub_value = _num(sub.get("value"))
             if sub_value is not None:
                 out[prefix + str(sub_metric)] = sub_value
+                _note_direction(directions, prefix + str(sub_metric), sub)
 
     # observatory artifact
     slo = doc.get("slo")
@@ -84,16 +105,19 @@ def flatten(doc: dict, prefix: str = "") -> Dict[str, float]:
                 else _num(entry)
             if v is not None:
                 out[f"{prefix}kernel.{name}"] = v
+                _note_direction(directions, f"{prefix}kernel.{name}", entry)
     return out
 
 
-def load_metrics(path: str) -> Dict[str, float]:
+def load_metrics(path: str,
+                 directions: Optional[Dict[str, str]] = None
+                 ) -> Dict[str, float]:
     """Flatten a file that is one JSON document or a JSON-line stream
     (bench_suite output); later lines win on metric collisions."""
     with open(path) as f:
         text = f.read()
     try:
-        return flatten(json.loads(text))
+        return flatten(json.loads(text), directions=directions)
     except ValueError:
         out: Dict[str, float] = {}
         for line in text.splitlines():
@@ -101,19 +125,25 @@ def load_metrics(path: str) -> Dict[str, float]:
             if not line:
                 continue
             try:
-                out.update(flatten(json.loads(line)))
+                out.update(flatten(json.loads(line), directions=directions))
             except ValueError:
                 continue  # interleaved log noise
         return out
 
 
 def compare(baseline: Dict[str, float], current: Dict[str, float],
-            tolerance: float) -> List[dict]:
-    """Per-common-metric verdicts, regressions first."""
+            tolerance: float,
+            directions: Optional[Dict[str, str]] = None) -> List[dict]:
+    """Per-common-metric verdicts, regressions first.  ``directions``
+    carries the artifacts' explicit per-metric overrides; metrics
+    without one fall back to name inference."""
+    directions = directions or {}
     rows = []
     for metric in sorted(set(baseline) & set(current)):
         base, cur = baseline[metric], current[metric]
-        lower = lower_is_better(metric)
+        override = directions.get(metric)
+        lower = (override == "lower") if override \
+            else lower_is_better(metric)
         if base == 0:
             regressed = lower and cur > 0 and tolerance < 1
             ratio = None
@@ -124,6 +154,8 @@ def compare(baseline: Dict[str, float], current: Dict[str, float],
         rows.append({"metric": metric, "baseline": base, "current": cur,
                      "ratio": round(ratio, 4) if ratio is not None else None,
                      "direction": "lower" if lower else "higher",
+                     "direction_source": "artifact" if override
+                     else "inferred",
                      "regressed": regressed})
     rows.sort(key=lambda r: (not r["regressed"], r["metric"]))
     return rows
@@ -145,9 +177,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="print verdicts but always exit 0")
     args = ap.parse_args(argv)
 
+    # direction overrides merge across both artifacts; the current one
+    # wins (it carries the newest metadata for renamed/retyped metrics)
+    directions: Dict[str, str] = {}
     try:
-        baseline = load_metrics(args.against)
-        current = load_metrics(args.current)
+        baseline = load_metrics(args.against, directions)
+        current = load_metrics(args.current, directions)
     except OSError as e:
         print(f"gate: cannot read artifact: {e}", file=sys.stderr)
         return 2
@@ -157,7 +192,7 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
 
-    rows = compare(baseline, current, args.tolerance)
+    rows = compare(baseline, current, args.tolerance, directions)
     regressions = [r for r in rows if r["regressed"]]
     report = {
         "against": args.against, "current": args.current,

@@ -60,6 +60,26 @@ def kernel_bench(seconds: float = 0.4) -> dict:
             out["verify_native"] = {
                 "value": round(reps * len(digests) / elapsed, 1),
                 "unit": "sigs/s"}
+    try:
+        from ..benchutil import verify_pipeline_bench
+
+        vp = verify_pipeline_bench(seconds=min(seconds, 0.4))
+        # explicit direction overrides (consumed by gate.py): the
+        # speedup/rate names don't match its latency-token inference
+        out["verify_pipeline"] = {
+            "value": round(vp["pipelined_tx_s"], 1), "unit": "tx/s",
+            "direction": "higher",
+            "verdicts_equal": vp["verdicts_equal"],
+            "differential_txs": vp["differential_txs"]}
+        out["verify_pipeline_serial"] = {
+            "value": round(vp["serial_tx_s"], 1), "unit": "tx/s",
+            "direction": "higher"}
+        out["verify_pipeline_speedup"] = {
+            "value": round(vp["speedup"], 2) if vp["verdicts_equal"]
+            else 0.0,  # divergence zeroes the headline so the gate trips
+            "unit": "x", "direction": "higher"}
+    except Exception as e:
+        log.warning("verify_pipeline bench skipped: %s", e)
     return out
 
 

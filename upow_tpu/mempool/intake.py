@@ -5,8 +5,10 @@ intake: concurrent ``push_tx`` requests are queued, drained in
 micro-batches (``coalesce_window_ms`` / ``max_intake_batch``), each tx
 runs its host-side rule checks individually, and every surviving
 ``SigCheck`` across the whole batch goes to P-256 verification in ONE
-``run_sig_checks_async`` dispatch — N concurrent requests cost ≪ N
-device round-trips.  The degrade manager still decides the batch's
+submission to the shared dispatch front (verify/dispatch.py) — N
+concurrent requests cost ≪ N device round-trips, and an intake batch
+landing while block verify is in flight shares ITS dispatch too.  The
+degrade manager still decides the batch's
 backend (``_resolve_backend`` inside run_sig_checks consults DEGRADE),
 so a benched TPU transparently serves the batch on the host path.
 
@@ -32,7 +34,8 @@ from typing import Dict, List, Optional
 from .. import trace
 from ..logger import get_logger
 from ..resilience.faultinject import FaultInjected, get_injector
-from ..verify import txverify
+from ..verify import txverify  # noqa: F401  (re-exported: tests patch via this module)
+from ..verify.dispatch import get_front
 from .pool import MempoolEntry
 
 log = get_logger("mempool")
@@ -248,11 +251,15 @@ class IntakeCoordinator:
             t_dispatch = time.perf_counter()
             try:
                 with trace.span("mempool.sig_dispatch", n=len(flat)):
-                    verdicts = await txverify.run_sig_checks_async(
+                    # shared batched-dispatch front (verify/dispatch.py):
+                    # an intake batch arriving while block verify has a
+                    # micro-batch in flight coalesces into ONE device
+                    # dispatch with it — verdict semantics unchanged
+                    verdicts = await get_front().submit(
                         flat, backend=dev.sig_backend,
                         pad_block=dev.verify_pad_block,
                         device_timeout=dev.verify_device_timeout,
-                        mesh_devices=dev.mesh_devices)
+                        mesh_devices=dev.mesh_devices, source="mempool")
             except Exception as e:  # serial parity: verify errors reject
                 log.warning("intake signature dispatch failed: %s", e)
                 for req in survivors:

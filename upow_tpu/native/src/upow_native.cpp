@@ -19,6 +19,7 @@
 #include <cstddef>
 #if defined(__x86_64__) && defined(__GNUC__)
 #include <immintrin.h>  // SHA-NI intrinsics (guarded per-function below)
+#include <cpuid.h>      // runtime SHA/SSE4.1 detection (shani_available)
 #endif
 
 // ---------------------------------------------------------------- sha256 --
@@ -73,8 +74,16 @@ static void compress(uint32_t state[8], const uint8_t block[64]) {
 // core.  Compiled with a per-function target attribute so the rest of the
 // library needs no -m flags; selected at runtime via cpuid.
 static bool shani_available() {
-  __builtin_cpu_init();
-  return __builtin_cpu_supports("sha") && __builtin_cpu_supports("sse4.1");
+  // raw cpuid, not __builtin_cpu_supports("sha"): gcc only learned the
+  // "sha" feature name in 11.x, and the distro toolchain here is older
+  unsigned eax, ebx, ecx, edx;
+  if (!__get_cpuid_count(7, 0, &eax, &ebx, &ecx, &edx) ||
+      !(ebx & (1u << 29)))  // CPUID.7.0:EBX.SHA
+    return false;
+  if (!__get_cpuid(1, &eax, &ebx, &ecx, &edx) ||
+      !(ecx & (1u << 19)))  // CPUID.1:ECX.SSE4.1
+    return false;
+  return true;
 }
 
 // LANES independent single-block compressions interleaved: sha256rnds2

@@ -119,7 +119,10 @@ class Node:
             self.state, sig_backend=self.config.device.sig_backend,
             verify_pad_block=self.config.device.verify_pad_block,
             verify_device_timeout=self.config.device.verify_device_timeout,
-            verify_mesh_devices=self.config.device.mesh_devices)
+            verify_mesh_devices=self.config.device.mesh_devices,
+            verify_microbatch=self.config.device.verify_microbatch,
+            txid_backend=self.config.device.txid_backend,
+            txid_min_batch=self.config.device.txid_min_batch)
         rcfg = self.config.resilience
         self.breakers = BreakerRegistry(
             failure_threshold=rcfg.breaker_failure_threshold,

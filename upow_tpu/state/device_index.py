@@ -1,33 +1,39 @@
-"""Device-resident UTXO membership prefilter (SURVEY.md §2.2).
+"""Device-resident exact UTXO outpoint index (SURVEY.md §2.2, ISSUE 7).
 
 The block-accept hot path tests every input outpoint against the unspent
-set (reference manager.py:531-615 does per-class SQL set-diffs).  Here
-outpoints are fingerprinted to 32 bits (first 4 bytes of
-sha256(tx_hash || index)), kept as ONE sorted int32 array in HBM, and a
-whole block's inputs are tested with a single ``searchsorted`` + gather
-compare.  (int32, not int64: without jax_enable_x64 JAX silently
-downcasts 64-bit arrays, which would truncate AFTER the host sort and
-hand searchsorted an unsorted array.)
+set (reference manager.py:531-615 does per-class SQL set-diffs).  Earlier
+rounds kept a 32-bit *prefilter* here and escalated every hit to batched
+SQL.  This round promotes it to an **exact** index:
 
-The fingerprint is a *prefilter*, not the consensus decision:
+* 64-bit fingerprint per outpoint — the first 8 bytes of the (already
+  uniformly distributed) txid, mixed with the output index.  Computed for
+  whole batches in ONE ``np.frombuffer`` pass over the joined hash
+  prefixes instead of a Python-level hashlib loop per outpoint.
+* a host-side exact map ``fp64 -> [outpoints]`` that resolves the
+  astronomically-rare (but adversarially grindable, and therefore
+  handled) 64-bit twins, so membership answers are EXACT — the SQL
+  escalation that used to confirm every prefilter hit is gone from the
+  hot path.
+* a sorted host ``uint64`` key array maintained by incremental
+  ``searchsorted`` + ``insert``/``delete`` — block accept appends a
+  sorted slab into place instead of re-sorting the whole set.
+* an HBM-resident int32 shadow of the high 32 fingerprint bits (order
+  preserved by flipping the sign bit: ``(hi ^ 0x8000_0000)`` viewed as
+  int32) for the one-dispatch ``searchsorted`` prefilter.  int32, not
+  int64: without jax_enable_x64 JAX silently downcasts 64-bit arrays,
+  which would truncate AFTER the host sort and hand searchsorted an
+  unsorted array.
 
-* fingerprint miss -> outpoint is definitely NOT unspent (exact), so
-  double-spend floods and bad forks reject after one device call;
-* fingerprint hit  -> "maybe" — the caller escalates to storage
-  (``ChainState.outpoints_exist`` confirms hits with its batched SQL).
-
-Holding only 4 bytes per outpoint host+device-side, the index scales to
-many millions of UTXOs.  Trusting hits outright would be unsound — a
-32-bit collision (trivially grindable, and ~0.02%/query by chance at
-1M UTXOs) must cost one SQL confirm, never a wrong verdict — hence the
-escalation, exactly the SURVEY §2.2 design.
+``contains_batch`` is the exact membership test (device prefilter to
+reject definite misses in one dispatch, host map to confirm the hits).
+``maybe_contains_batch`` keeps the historical prefilter contract (False
+is definitive absence; True means "maybe") for callers that only want
+the cheap device-side reject.
 """
 
 from __future__ import annotations
 
-import hashlib
-from collections import Counter
-from typing import Iterable, List, Sequence, Tuple
+from typing import Dict, Iterable, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,12 +41,48 @@ import numpy as np
 
 Outpoint = Tuple[str, int]
 
+# Odd 64-bit mixing constant (2^64 / golden ratio).  The txid prefix is
+# already uniform (it IS sha256 output); the multiply spreads the output
+# index so (h, 0) and (h, 1) land far apart.
+_MIX = 0x9E3779B97F4A7C15
+_U64 = 0xFFFFFFFFFFFFFFFF
+
 
 def fingerprint(outpoint: Outpoint) -> int:
+    """64-bit unsigned fingerprint of one outpoint: XOR-fold of the four
+    u64 lanes of the (already sha256-uniform) txid, mixed with the
+    output index.  Folding the WHOLE hash — not a prefix — keeps the
+    fingerprint discriminating even for structured/test txids; grinding
+    a collision still costs sha256 birthday work (~2^32), and the exact
+    map makes collisions a perf footnote, never a wrong verdict.
+
+    Must stay bit-identical to ``fingerprint_batch`` — the class mixes
+    both paths freely.
+    """
     tx_hash, index = outpoint
-    digest = hashlib.sha256(
-        bytes.fromhex(tx_hash) + index.to_bytes(2, "little")).digest()
-    return int.from_bytes(digest[:4], "little", signed=True)  # int32
+    raw = bytes.fromhex(tx_hash)
+    base = 0
+    for off in range(0, 32, 8):
+        base ^= int.from_bytes(raw[off:off + 8], "little")
+    return (base ^ ((index + 1) * _MIX)) & _U64
+
+
+def fingerprint_batch(outpoints: Sequence[Outpoint]) -> np.ndarray:
+    """(N,) uint64 fingerprints in one ``np.frombuffer`` pass.
+
+    One joined-hex decode + one frombuffer + vectorized fold/mix — no
+    per-outpoint hashlib/int.from_bytes loop (satellite: measurable
+    per-block host win on 8k-input blocks).
+    """
+    n = len(outpoints)
+    if not n:
+        return np.zeros(0, dtype=np.uint64)
+    blob = bytes.fromhex("".join(o[0] for o in outpoints))
+    lanes = np.frombuffer(blob, dtype="<u8").reshape(n, 4)
+    base = np.bitwise_xor.reduce(lanes, axis=1)
+    idx = np.fromiter((o[1] for o in outpoints), dtype=np.uint64, count=n)
+    with np.errstate(over="ignore"):
+        return base ^ ((idx + np.uint64(1)) * np.uint64(_MIX))
 
 
 @jax.jit
@@ -50,68 +92,139 @@ def _member_mask(sorted_keys, queries):
     return sorted_keys[pos] == queries
 
 
+def _hi32_i32(fps: np.ndarray) -> np.ndarray:
+    """High 32 fingerprint bits as order-preserving int32 (sign-bit flip
+    maps uint32 order onto int32 order)."""
+    hi = (fps >> np.uint64(32)).astype(np.uint32)
+    return (hi ^ np.uint32(0x80000000)).view(np.int32)
+
+
 class DeviceUtxoIndex:
-    """Sorted-fingerprint membership prefilter, one per UTXO-class table."""
+    """Exact sorted-fingerprint outpoint index, one per UTXO-class table."""
 
     def __init__(self, outpoints: Iterable[Outpoint] = ()):
-        # MULTISET of fingerprints: two live outpoints may share one
-        # (expected ~n²/2³³ pairs — ~100 at 1M UTXOs).  A plain set would
-        # over-remove when one twin is spent, and a wrong "definitely
-        # absent" on the survivor would REJECT a valid block — the one
-        # error class a prefilter must never produce.
-        self._fps = Counter(fingerprint(o) for o in outpoints)
+        ops = [tuple(o) for o in outpoints]
+        fps = fingerprint_batch(ops)
+        # exact map: fp64 -> live outpoints with that fingerprint.  A
+        # list, not a set: duplicates mirror the old multiset semantics
+        # (add twice -> remove twice), and twins (distinct outpoints, one
+        # fp64) stay individually tracked so spending one never makes the
+        # survivor report absent — the one error class the index must
+        # never produce.
+        self._exact: Dict[int, List[Outpoint]] = {}
+        for o, fp in zip(ops, fps.tolist()):
+            self._exact.setdefault(fp, []).append(o)
+        keys = fps.copy()
+        keys.sort()
+        self._host_keys = keys          # sorted uint64, one entry per live op
         self._dirty = True
-        self._keys = None
+        self._keys = None               # device int32 shadow (lazy)
 
     def __len__(self):
-        return sum(self._fps.values())
+        return int(self._host_keys.shape[0])
+
+    # ------------------------------------------------------------ updates --
 
     def add(self, outpoints: Iterable[Outpoint]) -> None:
-        self._fps.update(fingerprint(o) for o in outpoints)
+        ops = [tuple(o) for o in outpoints]
+        if not ops:
+            return
+        fps = fingerprint_batch(ops)
+        for o, fp in zip(ops, fps.tolist()):
+            self._exact.setdefault(fp, []).append(o)
+        # incremental sorted insert: sort only the (small) slab, then
+        # splice it into place — no full re-sort of the whole key set
+        slab = np.sort(fps)
+        pos = np.searchsorted(self._host_keys, slab)
+        self._host_keys = np.insert(self._host_keys, pos, slab)
         self._dirty = True
 
     def remove(self, outpoints: Iterable[Outpoint]) -> None:
-        for o in outpoints:
-            fp = fingerprint(o)
-            left = self._fps[fp] - 1
-            if left > 0:
-                self._fps[fp] = left
-            elif fp in self._fps:
-                del self._fps[fp]
-            # absent entries are a no-op, matching the SQL DELETE and the
-            # old set semantics (e.g. replaying a log whose spend
-            # references a never-created output must report a MISMATCH,
-            # not crash)
+        ops = [tuple(o) for o in outpoints]
+        if not ops:
+            return
+        removed: List[int] = []
+        for o, fp in zip(ops, fingerprint_batch(ops).tolist()):
+            bucket = self._exact.get(fp)
+            if bucket is None or o not in bucket:
+                # absent entries are a no-op, matching the SQL DELETE
+                # (e.g. replaying a log whose spend references a
+                # never-created output must report a MISMATCH, not crash)
+                continue
+            bucket.remove(o)
+            if not bucket:
+                del self._exact[fp]
+            removed.append(fp)
+        if not removed:
+            return
+        rem = np.sort(np.array(removed, dtype=np.uint64))
+        pos = np.searchsorted(self._host_keys, rem, side="left")
+        # k-th duplicate of an equal fp deletes the k-th occurrence
+        off = np.arange(len(rem)) - np.searchsorted(rem, rem, side="left")
+        self._host_keys = np.delete(self._host_keys, pos + off)
         self._dirty = True
+
+    def apply_block(self, created: Sequence[Outpoint],
+                    spent: Sequence[Outpoint]) -> None:
+        """Batched spend/create application for one accepted (or
+        rolled-back, with the roles swapped) block."""
+        if spent:
+            self.remove(spent)
+        if created:
+            self.add(created)
+
+    # ------------------------------------------------------------ queries --
 
     def _device_keys(self):
         if self._dirty:
-            keys = np.fromiter(self._fps.keys(), dtype=np.int32,
-                               count=len(self._fps))
-            keys.sort()
-            # pad to a non-empty power-of-two length to bound recompiles
+            keys = _hi32_i32(self._host_keys)
+            # drop twin duplicates device-side (mask only needs presence)
+            # and pad to a non-empty power-of-two to bound recompiles
+            keys = np.unique(keys)
             n = max(1, 1 << (len(keys) - 1).bit_length()) if len(keys) else 1
             pad = np.full(n - len(keys), np.iinfo(np.int32).max, dtype=np.int32)
             self._keys = jnp.asarray(np.concatenate([keys, pad]))
             self._dirty = False
         return self._keys
 
-    def maybe_contains_batch(self, outpoints: Sequence[Outpoint]) -> np.ndarray:
-        """(N,) bool: False is definitive absence; True means escalate."""
-        if not outpoints:
-            return np.zeros(0, dtype=bool)
-        queries = np.fromiter(
-            (fingerprint(o) for o in outpoints), dtype=np.int32,
-            count=len(outpoints),
-        )
+    def _prefilter(self, fps: np.ndarray) -> np.ndarray:
+        queries = _hi32_i32(fps)
         n = 1 << (len(queries) - 1).bit_length() if len(queries) else 1
         padded = np.concatenate([
-            queries, np.full(n - len(queries), np.iinfo(np.int32).min, np.int32)])
+            queries,
+            np.full(n - len(queries), np.iinfo(np.int32).min, np.int32)])
         return np.asarray(
             _member_mask(self._device_keys(), jnp.asarray(padded))
-        )[: len(outpoints)]
+        )[: len(fps)]
+
+    def maybe_contains_batch(self, outpoints: Sequence[Outpoint]) -> np.ndarray:
+        """(N,) bool prefilter: False is definitive absence; True means
+        a high-32-bit fingerprint hit (use ``contains_batch`` for the
+        exact answer)."""
+        if not outpoints:
+            return np.zeros(0, dtype=bool)
+        return self._prefilter(fingerprint_batch(outpoints))
+
+    def contains_batch(self, outpoints: Sequence[Outpoint]) -> np.ndarray:
+        """(N,) bool EXACT membership — no SQL escalation needed.
+
+        One device ``searchsorted`` dispatch rejects definite misses;
+        the host exact map confirms each surviving hit (including
+        resolving fp64 twins down to the precise outpoint).
+        """
+        if not outpoints:
+            return np.zeros(0, dtype=bool)
+        ops = [tuple(o) for o in outpoints]
+        fps = fingerprint_batch(ops)
+        maybe = self._prefilter(fps)
+        out = np.zeros(len(ops), dtype=bool)
+        fp_list = fps.tolist()
+        for i in np.nonzero(maybe)[0]:
+            bucket = self._exact.get(fp_list[i])
+            out[i] = bucket is not None and ops[i] in bucket
+        return out
 
     def missing(self, outpoints: Sequence[Outpoint]) -> List[Outpoint]:
-        """Outpoints that are definitely absent (no escalation needed)."""
-        maybe = self.maybe_contains_batch(outpoints)
-        return [o for o, m in zip(outpoints, maybe) if not m]
+        """Outpoints that are definitely absent (exact)."""
+        present = self.contains_batch(outpoints)
+        return [o for o, m in zip(outpoints, present) if not m]

@@ -792,18 +792,22 @@ class PgChainState(StateViews):
 
     async def remove_outputs(self, txs: Sequence[AnyTx]) -> None:
         """Spend inputs from the table their tx type targets
-        (database.py:589-622)."""
+        (database.py:589-622).  Grouped per table: one DELETE
+        executemany + one batched index apply per UTXO class."""
+        by_table: Dict[str, list] = {}
+        for tx in txs:
+            if tx.is_coinbase:
+                continue
+            table = _INPUT_TABLE.get(tx.transaction_type, "unspent_outputs")
+            by_table.setdefault(table, []).extend(
+                (i.tx_hash, i.index) for i in tx.inputs)
         async with self._txn():
-            for tx in txs:
-                if tx.is_coinbase:
-                    continue
-                table = _INPUT_TABLE.get(tx.transaction_type,
-                                         "unspent_outputs")
+            for table, outpoints in by_table.items():
                 await self.drv.aexecutemany(
                     f'DELETE FROM {table} WHERE tx_hash = $1'
                     ' AND "index" = $2',
-                    [(i.tx_hash, i.index) for i in tx.inputs])
-                self._index_remove(table, [i.outpoint for i in tx.inputs])
+                    outpoints)
+                self._index_remove(table, outpoints)
 
     async def get_unspent_outpoints(self,
                                     table: str = "unspent_outputs") -> set:
@@ -813,15 +817,17 @@ class PgChainState(StateViews):
     async def outpoints_exist(self, outpoints: List[Tuple[str, int]],
                               table: str = "unspent_outputs") -> List[bool]:
         """Batched membership test, same shape as the sqlite backend's
-        (storage.py outpoints_exist), device prefilter included."""
+        (storage.py outpoints_exist).  With the device index enabled the
+        answer is exact and SQL-free (the index's host map resolves
+        fingerprint twins); the index assumes this node is the sole
+        writer of the UTXO tables — the same assumption the journal and
+        block-accept paths already make."""
         if not outpoints:
             return []
         if self._dev_index is not None and table in self._dev_index:
-            maybe = self._dev_index[table].maybe_contains_batch(
+            present = self._dev_index[table].contains_batch(
                 [tuple(o) for o in outpoints])
-            escalate = [o for o, m in zip(outpoints, maybe) if m]
-            confirmed = iter(await self._outpoints_exist_sql(escalate, table))
-            return [bool(m) and next(confirmed) for m in maybe]
+            return [bool(p) for p in present]
         return await self._outpoints_exist_sql(outpoints, table)
 
     async def _outpoints_exist_sql(self, outpoints, table) -> List[bool]:

@@ -847,16 +847,22 @@ class ChainState(StateViews):
 
     async def remove_outputs(self, txs: Sequence[AnyTx]) -> None:
         """Spend inputs from the table their tx type targets
-        (reference database.py:589-622)."""
+        (reference database.py:589-622).  Grouped per table so a whole
+        block is one DELETE executemany + one batched index apply per
+        UTXO class, not one per tx."""
+        by_table: Dict[str, list] = {}
         for tx in txs:
             if tx.is_coinbase:
                 continue
             table = _INPUT_TABLE.get(tx.transaction_type, "unspent_outputs")
+            by_table.setdefault(table, []).extend(
+                (i.tx_hash, i.index) for i in tx.inputs)
+        for table, outpoints in by_table.items():
             self.db.executemany(
                 f"DELETE FROM {table} WHERE tx_hash = ? AND idx = ?",
-                [(i.tx_hash, i.index) for i in tx.inputs],
+                outpoints,
             )
-            self._index_remove(table, [i.outpoint for i in tx.inputs])
+            self._index_remove(table, outpoints)
 
     async def get_unspent_outpoints(self, table: str = "unspent_outputs") -> set:
         rows = self.db.execute(f"SELECT tx_hash, idx FROM {table}").fetchall()
@@ -867,22 +873,20 @@ class ChainState(StateViews):
         """Batched membership test: one row-value IN query per 400 outpoints
         instead of a query per outpoint — an 8k-input block is ~20 queries.
         (The reference does a set-diff against a full-column fetch,
-        manager.py:531-615.)  With the device index enabled, one
-        ``searchsorted`` dispatch rejects definite misses first — a
-        double-spend flood or bad fork costs one device call — and only
-        fingerprint "maybes" escalate to the batched SQL below.  The
-        escalation is load-bearing, not a rarity: fingerprints are 32
-        bits (see device_index.py), so collisions are ~0.02%/query by
-        chance and trivially grindable on purpose — a hit must NEVER be
-        trusted as proof of existence."""
+        manager.py:531-615.)  With the device index enabled, the answer
+        is EXACT and SQL-free: one ``searchsorted`` dispatch rejects
+        definite misses, and the index's host-side exact map confirms
+        the hits — including resolving 64-bit fingerprint twins down to
+        the precise outpoint (see device_index.py).  The index is
+        maintained in lockstep with every INSERT/DELETE on these tables
+        and rebuilt on rollback, so its view always matches what this
+        connection's SQL would report."""
         if not outpoints:
             return []
         if self._dev_index is not None and table in self._dev_index:
-            maybe = self._dev_index[table].maybe_contains_batch(
+            present = self._dev_index[table].contains_batch(
                 [tuple(o) for o in outpoints])
-            escalate = [o for o, m in zip(outpoints, maybe) if m]
-            confirmed = iter(await self._outpoints_exist_sql(escalate, table))
-            return [bool(m) and next(confirmed) for m in maybe]
+            return [bool(p) for p in present]
         return await self._outpoints_exist_sql(outpoints, table)
 
     async def _outpoints_exist_sql(self, outpoints: List[Tuple[str, int]],

@@ -59,6 +59,16 @@ def configure(cfg=None) -> None:
         events.configure(cfg.events_buffer)
     device.preregister("p256_verify")
     device.preregister("sha256_txid")
+    for stage in ("block_decode", "block_sig_wait"):
+        device.preregister_stage(stage)
+    # shared sig dispatch front (verify/dispatch.py) — deferred import:
+    # telemetry must stay importable without the verify package
+    try:
+        from ..verify.dispatch import preregister as _front_preregister
+
+        _front_preregister()
+    except Exception as e:  # pragma: no cover - import-cycle guard
+        log.debug("dispatch front preregister skipped: %s", e)
 
 
 def reset() -> None:

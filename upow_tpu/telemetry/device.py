@@ -75,6 +75,36 @@ def record_batch(kernel: str, real: int, padded: int,
                     % (kernel, "hits" if hit else "misses"))
 
 
+def preregister_stage(stage: str) -> None:
+    """Create a pipeline stage's metric families (all-zero) so /metrics
+    exports them before the first block flows through the pipeline."""
+    metrics.ensure_histogram("pipeline.%s.seconds" % stage,
+                             DISPATCH_BUCKETS)
+    metrics.ensure_histogram("pipeline.%s.occupancy" % stage,
+                             OCCUPANCY_BUCKETS)
+    metrics.ensure_counter("pipeline.%s.items" % stage)
+
+
+def record_stage(stage: str, seconds: float, items: Optional[int] = None,
+                 wall: Optional[float] = None) -> None:
+    """Record one pipeline stage pass (ISSUE 7: pipelined block verify).
+
+    ``seconds`` is the stage's busy time; ``wall`` (when given) is the
+    whole pipeline's wall time for the same pass, making
+    ``pipeline.<stage>.occupancy`` the fraction of the pipeline the
+    stage kept busy — overlap shows up as stage occupancies summing
+    past 1.0, a serialized pipeline as fractions that add to ~1.0.
+    """
+    metrics.observe("pipeline.%s.seconds" % stage, max(seconds, 0.0),
+                    buckets=DISPATCH_BUCKETS)
+    if items:
+        metrics.inc("pipeline.%s.items" % stage, items)
+    if wall is not None and wall > 0:
+        metrics.observe("pipeline.%s.occupancy" % stage,
+                        min(max(seconds, 0.0) / wall, 1.0),
+                        buckets=OCCUPANCY_BUCKETS)
+
+
 def record_cost(kernel: str, analysis: dict) -> None:
     """Store an XLA ``compiled.cost_analysis()`` estimate for ``kernel``
     (``upow_tpu/profiling``): numeric entries only, keys sanitized to

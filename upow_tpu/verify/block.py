@@ -18,10 +18,11 @@ Rules and quirks are otherwise replicated exactly; citations inline.
 from __future__ import annotations
 
 import asyncio
+import functools
 import hashlib
 import time
 from decimal import Decimal
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..core.clock import timestamp as now_ts
 from ..core.constants import MAX_BLOCK_SIZE_HEX, SMALLEST
@@ -32,8 +33,10 @@ from ..core.merkle import merkle_root
 from ..core.rewards import get_block_reward, get_inode_rewards
 from ..core.tx import CoinbaseTx, Tx, TxOutput
 from ..state.storage import ChainState, _INPUT_TABLE
+from ..telemetry import device as ktel
 from ..trace import span
-from .txverify import TxVerifier, run_sig_checks_async
+from .dispatch import get_front
+from .txverify import TxVerifier, run_sig_checks_async  # noqa: F401  (re-exported for tests)
 
 # Historical chain patches: grandfathered double-spends by height and the
 # one merkle exception (consensus DATA for mainnet compatibility;
@@ -73,6 +76,58 @@ MERKLE_EXCEPTION = (
     340510, "54e7e3fbfe5c3c7b2a74d14efd22a61c231d157b2c5c2476fca67736736b9ac8")
 
 
+def _fused_digest_prep(transactions: Sequence[Tx],
+                       txid_backend: str = "host",
+                       txid_min_batch: int = 256) -> Dict[int, tuple]:
+    """Fused sha256 preparation for one verify micro-batch.
+
+    Per tx, THREE digests feed the hot path: the raw signing-bytes
+    digest and its hex-form twin (both consumed by the signature
+    checks) and the txid (consumed by merkle_root and storage).  The
+    serial path hashed each separately per tx; here all of them go
+    through ONE ``txid_batch`` call — shapes allow it, sha256 is
+    length-bucketed inside — and txids seed ``Tx._hash`` so the later
+    ``merkle_root`` is memo reads.  The txid seed is definitionally
+    safe: the payload IS ``bytes.fromhex(tx.hex())``, exactly what
+    ``Tx.hash()`` would digest.
+
+    The batch dispatch is gated exactly like the node's sync txid
+    prefill (node/app.py): a host backend, or a micro-batch below
+    ``txid_min_batch``, hashes inline with hashlib — fusing only pays
+    where a device dispatch is amortized.
+
+    Returns ``{id(tx): (digest, digest_hexform)}`` for
+    ``collect_sig_checks``.
+    """
+    payloads: List[bytes] = []
+    need_txid: List[bool] = []
+    for tx in transactions:
+        signing_hex = tx.hex(False)
+        payloads.append(bytes.fromhex(signing_hex))
+        payloads.append(signing_hex.encode())
+        need = getattr(tx, "_hash", "x") is None
+        need_txid.append(need)
+        if need:
+            payloads.append(bytes.fromhex(tx.hex()))
+    if txid_backend == "host" or len(payloads) < txid_min_batch:
+        digests = [hashlib.sha256(p).hexdigest() for p in payloads]
+    else:
+        from ..crypto.sha256 import txid_batch
+
+        digests = txid_batch(payloads, backend=txid_backend)
+    out: Dict[int, tuple] = {}
+    pos = 0
+    for tx, need in zip(transactions, need_txid):
+        pair = (bytes.fromhex(digests[pos]),
+                bytes.fromhex(digests[pos + 1]))
+        pos += 2
+        if need:
+            tx._hash = digests[pos]
+            pos += 1
+        out[id(tx)] = pair
+    return out
+
+
 class BlockManager:
     """Difficulty, check_block, create_block over one ChainState."""
 
@@ -80,7 +135,10 @@ class BlockManager:
                  verify_pad_block: int = 128,
                  # operational timeout, not consensus data
                  verify_device_timeout: float = 240.0,  # upowlint: disable=CP001
-                 verify_mesh_devices: int = 1):
+                 verify_mesh_devices: int = 1,
+                 verify_microbatch: int = 1024,
+                 txid_backend: str = "host",
+                 txid_min_batch: int = 256):
         self.state = state
         self.sig_backend = sig_backend
         self.verify_pad_block = verify_pad_block
@@ -88,6 +146,12 @@ class BlockManager:
         # DP-shard the device verify batch over a mesh (SURVEY §2.3):
         # 0 = all visible devices, 1 = single device, N = first N
         self.verify_mesh_devices = verify_mesh_devices
+        # pipelined check_block: txs per micro-batch (0 = whole block in
+        # one batch, i.e. no overlap) and the backend for the fused
+        # digest prep (config.device.txid_backend; "host" is hashlib)
+        self.verify_microbatch = verify_microbatch
+        self.txid_backend = txid_backend
+        self.txid_min_batch = txid_min_batch
         self._difficulty_cache: Optional[Tuple[Decimal, dict]] = None
         self._inode_cache: Optional[List[dict]] = None
         self._inode_cache_time = 0.0  # monotonic epoch, not consensus  # upowlint: disable=CP001
@@ -211,30 +275,77 @@ class BlockManager:
                     transactions, block_no, errors):
                 return False
 
-        # per-tx rules + ONE batched signature dispatch for the whole block
+        # pipelined verify (ISSUE 7 tentpole b/c): the block is split into
+        # micro-batches; the fused digest prep (tx decode + txid/digest
+        # sha256) of batch N runs on the executor and OVERLAPS the batched
+        # P-256 verify of batch N-1, which is already in flight through the
+        # shared dispatch front.  Verdicts are only inspected after the
+        # full rules loop, so error ordering is byte-identical to the old
+        # serial path: a rules failure always surfaces before a signature
+        # verdict, and the error strings are unchanged.
         verifier = TxVerifier(
             self.state, is_syncing=self.is_syncing,
             verify_pad_block=self.verify_pad_block,
             verify_device_timeout=self.verify_device_timeout,
             verify_mesh_devices=self.verify_mesh_devices)
-        all_checks: List[tuple] = []
-        for tx in transactions:
-            if not await verifier.rules_ok(tx, check_double_spend=False):
-                errors.append(f"transaction {tx.hash()} has been not verified")
-                return False
-            checks = await verifier.collect_sig_checks(tx)
-            if checks is None:
-                errors.append(f"transaction {tx.hash()} has been not verified")
-                return False
-            all_checks.extend(checks)
-        with span("block.sig_verify", n=len(all_checks)):
-            verdicts_ok = all(await run_sig_checks_async(
-                all_checks, backend=self.sig_backend,
-                pad_block=self.verify_pad_block,
-                device_timeout=self.verify_device_timeout,
-                precomputed=self.page_sig_verdicts,
-                mesh_devices=self.verify_mesh_devices))
-        if not verdicts_ok:
+        front = get_front()
+        loop = asyncio.get_event_loop()
+        mb = self.verify_microbatch or len(transactions) or 1
+        dispatches: List[asyncio.Future] = []
+        n_checks = 0
+        decode_busy = 0.0  # telemetry accumulator  # upowlint: disable=CP001
+        t_wall = time.perf_counter()
+        failed_tx: Optional[Tx] = None
+        for start in range(0, len(transactions), mb):
+            chunk = transactions[start:start + mb]
+            t0 = time.perf_counter()
+            prep = await loop.run_in_executor(None, functools.partial(
+                _fused_digest_prep, chunk, self.txid_backend,
+                self.txid_min_batch))
+            chunk_checks: List[tuple] = []
+            for tx in chunk:
+                if not await verifier.rules_ok(tx, check_double_spend=False):
+                    failed_tx = tx
+                    break
+                checks = await verifier.collect_sig_checks(
+                    tx, digests=prep.get(id(tx)))
+                if checks is None:
+                    failed_tx = tx
+                    break
+                chunk_checks.extend(checks)
+            decode_busy += time.perf_counter() - t0
+            if failed_tx is not None:
+                break
+            n_checks += len(chunk_checks)
+            if chunk_checks:
+                # dispatch_fn resolves run_sig_checks_async through THIS
+                # module's globals so the long-standing patch seam
+                # (tests monkeypatch block.run_sig_checks_async) keeps
+                # intercepting the block path behind the shared front
+                dispatches.append(asyncio.ensure_future(front.submit(
+                    chunk_checks, backend=self.sig_backend,
+                    pad_block=self.verify_pad_block,
+                    device_timeout=self.verify_device_timeout,
+                    mesh_devices=self.verify_mesh_devices,
+                    precomputed=self.page_sig_verdicts, source="block",
+                    dispatch_fn=run_sig_checks_async)))
+        if failed_tx is not None:
+            for d in dispatches:
+                d.cancel()
+            await asyncio.gather(*dispatches, return_exceptions=True)
+            errors.append(
+                f"transaction {failed_tx.hash()} has been not verified")
+            return False
+        t_tail = time.perf_counter()
+        with span("block.sig_verify", n=n_checks,
+                  micro_batches=len(dispatches)):
+            results = await asyncio.gather(*dispatches)
+        wall = time.perf_counter() - t_wall
+        ktel.record_stage("block_decode", decode_busy,
+                          items=len(transactions), wall=wall)
+        ktel.record_stage("block_sig_wait", time.perf_counter() - t_tail,
+                          items=n_checks, wall=wall)
+        if not all(all(r) for r in results):
             errors.append("signature verification failed")
             return False
 

@@ -1,0 +1,180 @@
+"""Shared batched-dispatch front for P-256 signature verification.
+
+First slice of ROADMAP item 3 (the co-resident kernel server): every
+subsystem that needs signature verdicts — block verify's micro-batches
+(verify/block.py), mempool intake's coalesced admission batches
+(mempool/intake.py), benches — submits its checks HERE instead of
+calling :func:`txverify.run_sig_checks_async` directly.  Submissions
+queue; a per-event-loop drainer flattens every queued submission with
+compatible dispatch parameters into ONE ``run_sig_checks_async`` call
+and scatters the verdicts back.  While one dispatch is in flight on the
+executor thread, new submissions pile up and form the next coalesced
+batch — the natural double-buffering that keeps the device (or the
+OpenMP host batch) fed while callers decode/hash the next micro-batch.
+
+Verdict semantics are exactly :func:`txverify.run_sig_checks`'s — the
+front only changes WHO shares a dispatch, never what is computed — so
+wire behaviour stays byte-identical to the serial paths (pinned by the
+differential tests in tests/test_verify_pipeline.py).
+
+Telemetry (telemetry/device.py): each coalesced dispatch records a
+``sig_front`` kernel batch (occupancy = submitted lanes / pad-block
+rounded lanes), plus ``pipeline.front.*`` counters and a coalesced
+submissions-per-dispatch histogram — the cross-subsystem sharing is
+directly observable on /metrics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..logger import get_logger
+from ..telemetry import device as ktel
+from ..telemetry import metrics
+from . import txverify
+
+log = get_logger("verify.dispatch")
+
+COALESCE_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32)
+
+
+class _Submission:
+    __slots__ = ("checks", "key", "precomputed", "fut", "source",
+                 "dispatch_fn", "t0")
+
+    def __init__(self, checks, key, precomputed, fut, source, dispatch_fn):
+        self.checks = checks
+        self.key = key
+        self.precomputed = precomputed
+        self.fut = fut
+        self.source = source
+        self.dispatch_fn = dispatch_fn
+        self.t0 = time.perf_counter()
+
+
+class SigDispatchFront:
+    """Per-event-loop coalescing queue in front of run_sig_checks."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self._loop = loop
+        self._queue: List[_Submission] = []
+        self._drainer: Optional[asyncio.Task] = None
+        # introspection for tests/benches: dispatches actually issued
+        # and total submissions coalesced into them
+        self.dispatches = 0
+        self.submissions = 0
+
+    async def submit(self, checks: Sequence[tuple], *,
+                     backend: str = "auto",
+                     pad_block: int = 128,
+                     device_timeout: float = 240.0,  # operational timeout  # upowlint: disable=CP001
+                     mesh_devices: int = 1,
+                     precomputed: Optional[dict] = None,
+                     source: str = "other",
+                     dispatch_fn=None) -> List[bool]:
+        """Queue one batch of sig checks; resolves to its verdict list.
+
+        Submissions sharing (backend, pad_block, device_timeout,
+        mesh_devices, precomputed identity, dispatch_fn identity)
+        coalesce into one dispatch; incompatible ones dispatch
+        separately in arrival order.  ``dispatch_fn`` lets a caller
+        inject the underlying verify callable (callers resolve it from
+        their own module globals, so established monkeypatch seams keep
+        intercepting their path); the default — and anything identical
+        to it — is :func:`txverify.run_sig_checks_async`.
+        """
+        if not checks:
+            return []
+        if dispatch_fn is txverify.run_sig_checks_async:
+            dispatch_fn = None  # default fn must not split coalescing keys
+        key = (backend, pad_block, device_timeout, mesh_devices,
+               id(precomputed) if precomputed is not None else None,
+               id(dispatch_fn) if dispatch_fn is not None else None)
+        fut: asyncio.Future = self._loop.create_future()
+        self._queue.append(
+            _Submission(list(checks), key, precomputed, fut, source,
+                        dispatch_fn))
+        self.submissions += 1
+        metrics.inc("pipeline.front.submissions")
+        metrics.inc("pipeline.front.source.%s" % source)
+        self._ensure_drainer()
+        return await fut
+
+    def _ensure_drainer(self) -> None:
+        if self._drainer is not None and not self._drainer.done():
+            return
+        self._drainer = self._loop.create_task(self._drain())
+
+    async def _drain(self) -> None:
+        while self._queue:
+            head_key = self._queue[0].key
+            group = [s for s in self._queue if s.key == head_key]
+            self._queue = [s for s in self._queue if s.key != head_key]
+            await self._dispatch_group(group)
+
+    async def _dispatch_group(self, group: List[_Submission]) -> None:
+        flat: List[tuple] = []
+        slices: List[Tuple[int, int]] = []
+        for s in group:
+            slices.append((len(flat), len(flat) + len(s.checks)))
+            flat.extend(s.checks)
+        backend, pad_block, device_timeout, mesh_devices, _, _ = group[0].key
+        self.dispatches += 1
+        metrics.inc("pipeline.front.dispatches")
+        metrics.observe("pipeline.front.coalesced", len(group),
+                        buckets=COALESCE_BUCKETS)
+        t0 = time.perf_counter()
+        fn = group[0].dispatch_fn or txverify.run_sig_checks_async
+        try:
+            verdicts = await fn(
+                flat, backend=backend, pad_block=pad_block,
+                device_timeout=device_timeout,
+                precomputed=group[0].precomputed,
+                mesh_devices=mesh_devices)
+        except Exception as e:
+            # not swallowed: every submitter in the group re-raises it
+            log.debug("coalesced sig dispatch failed (%d submissions): %s",
+                      len(group), e)
+            for s in group:
+                if not s.fut.done():
+                    s.fut.set_exception(e)
+            return
+        finally:
+            padded = max(pad_block, 1) * (
+                (len(flat) + max(pad_block, 1) - 1) // max(pad_block, 1))
+            ktel.record_batch("sig_front", real=len(flat), padded=padded,
+                              seconds=time.perf_counter() - t0)
+        for s, (lo, hi) in zip(group, slices):
+            if not s.fut.done():
+                s.fut.set_result(verdicts[lo:hi])
+
+
+_FRONTS: Dict[int, SigDispatchFront] = {}
+_MAX_FRONTS = 32  # dead test loops accumulate; keep the map bounded
+
+
+def get_front() -> SigDispatchFront:
+    """The calling event loop's dispatch front (one per loop: futures
+    and the drainer task are loop-bound; tests spin up fresh loops)."""
+    loop = asyncio.get_event_loop()
+    front = _FRONTS.get(id(loop))
+    if front is None or front._loop is not loop or loop.is_closed():
+        if len(_FRONTS) >= _MAX_FRONTS:
+            for key in [k for k, f in _FRONTS.items()
+                        if f._loop.is_closed()]:
+                del _FRONTS[key]
+            if len(_FRONTS) >= _MAX_FRONTS:
+                _FRONTS.clear()
+        front = SigDispatchFront(loop)
+        _FRONTS[id(loop)] = front
+    return front
+
+
+def preregister() -> None:
+    """Export the front's metric families before the first dispatch."""
+    ktel.preregister("sig_front")
+    metrics.ensure_histogram("pipeline.front.coalesced", COALESCE_BUCKETS)
+    for c in ("submissions", "dispatches"):
+        metrics.ensure_counter("pipeline.front.%s" % c)

@@ -37,16 +37,22 @@ class SigCheck(Tuple):
     signature check."""
 
 
-def _dedup_sig_checks(tx: Tx, voter: bool,
-                      address_of) -> Optional[List[tuple]]:
+def _dedup_sig_checks(tx: Tx, voter: bool, address_of,
+                      digests: Optional[tuple] = None) -> Optional[List[tuple]]:
     """Collect per-input signature checks with the reference's dedup.
 
     Returns None if any input is unsigned or its key can't resolve.
     ``address_of(tx_input)`` -> spending (or voter) address string.
+    ``digests`` optionally carries the (digest, digest_hexform) pair a
+    fused batch prep (verify/block.py:_fused_digest_prep) already
+    computed, skipping the two per-tx hashlib passes here.
     """
-    signing_bytes = bytes.fromhex(tx.hex(False))
-    digest = hashlib.sha256(signing_bytes).digest()
-    digest_hexform = hashlib.sha256(tx.hex(False).encode()).digest()
+    if digests is not None:
+        digest, digest_hexform = digests
+    else:
+        signing_bytes = bytes.fromhex(tx.hex(False))
+        digest = hashlib.sha256(signing_bytes).digest()
+        digest_hexform = hashlib.sha256(tx.hex(False).encode()).digest()
     checks, seen = [], set()
     for tx_input in tx.inputs:
         if tx_input.signature is None:
@@ -184,6 +190,43 @@ def clear_sig_verdicts() -> None:
         _SIG_VERDICT_STATS["hits"] = _SIG_VERDICT_STATS["misses"] = 0
 
 
+_CANARY_LOCK = threading.Lock()
+_CANARY: Optional[Tuple[tuple, tuple]] = None
+_CANARY_EXPECTED = (True, False)
+
+
+def _canary_checks() -> Tuple[tuple, tuple]:
+    """Deterministic (known-good, known-bad) signature checks.
+
+    Appended to every device-path cache-miss dispatch; the device's
+    verdicts are admitted into the process-wide cache only when the
+    canaries come back exactly ``(True, False)``.  A device batch that
+    silently miscomputes (stale AOT cache entry, sick tunnel) then
+    taints at most the one dispatch it belongs to instead of being
+    replayed from the cache on every re-accept forever.  The key pair
+    is fixed and public BY DESIGN — it signs nothing but this
+    self-check message and guards no value.
+    """
+    global _CANARY
+    with _CANARY_LOCK:
+        if _CANARY is None:
+            from ..core import curve
+            from ..core.constants import CURVE_N
+
+            priv = 0x7E57AB1E_0000C0DE_7E57AB1E_0000C0DE % CURVE_N
+            k = 0x9E3779B97F4A7C15_F39CC060_5CEDC834 % CURVE_N
+            pub = curve.point_mul(priv, curve.G)
+            digest = hashlib.sha256(b"upow-tpu verify canary").digest()
+            hexform = hashlib.sha256(b"upow-tpu verify canary hex").digest()
+            z = int.from_bytes(digest, "big")  # upowlint: disable=CE001
+            r = curve.point_mul(k, curve.G)[0] % CURVE_N
+            s = (pow(k, -1, CURVE_N) * (z + r * priv)) % CURVE_N
+            good = (digest, hexform, (r, s), pub)
+            bad = (digest, hexform, (r, s - 1 if s > 1 else s + 1), pub)
+            _CANARY = (good, bad)
+        return _CANARY
+
+
 def _resolve_backend(backend: str, n_checks: int) -> str:
     """Apply the ``auto`` policy and the device-health override (single
     source for the cached and uncached layers)."""
@@ -229,13 +272,14 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
     (push_tx intake then check_block, transaction.py:185-238) on every
     gossiped tx.  Reorgs and sync re-accepts hit the same cache.
 
-    Only HOST-path verdicts are cached.  A device batch that silently
-    miscomputes (stale AOT cache entry, sick tunnel) would otherwise
-    turn one wrong verdict into a permanent one — replayed on every
-    re-accept even after the device path is poisoned off.  The benefit
-    survives: gossiped txs arrive one at a time, and batches under 8
-    signatures resolve to the host path, so intake still populates the
-    cache for the block accept that follows.
+    Host-path verdicts are always cached.  Device-path verdicts are
+    cached only when the batch's canary pair (:func:`_canary_checks`,
+    one known-good and one known-bad signature riding in the same
+    dispatch) comes back exactly (True, False): a device batch that
+    silently miscomputes (stale AOT cache entry, sick tunnel) would
+    otherwise turn one wrong verdict into a permanent one — replayed on
+    every re-accept even after the device path is poisoned off.  With
+    the canary gate, a sick batch taints at most itself.
     """
     if not checks:
         return []
@@ -270,13 +314,37 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
         if misses:
             miss_checks = [checks[i] for i in misses]
             resolved = _resolve_backend(backend, len(miss_checks))
+            dispatch_checks = miss_checks
+            canaries = 0
+            if resolved != "host":
+                # ride the canary pair along in the same device batch;
+                # their verdicts gate whether this batch may be cached
+                canary = _canary_checks()
+                dispatch_checks = miss_checks + list(canary)
+                canaries = len(canary)
             fresh = run_sig_checks(
-                miss_checks, backend=resolved,
+                dispatch_checks, backend=resolved,
                 pad_block=pad_block, device_timeout=device_timeout,
                 use_cache=False, mesh_devices=mesh_devices)
+            cacheable = resolved == "host"
+            if canaries:
+                canary_ok = tuple(fresh[-canaries:]) == _CANARY_EXPECTED
+                fresh = fresh[: len(miss_checks)]
+                from .. import trace
+
+                trace.inc("verify.canary_%s"
+                          % ("pass" if canary_ok else "fail"))
+                if canary_ok:
+                    cacheable = True
+                else:
+                    import logging
+
+                    logging.getLogger("upow_tpu.verify").warning(
+                        "device verify canary failed; %d verdicts NOT "
+                        "cached", len(miss_checks))
             for i, v in zip(misses, fresh):
                 out[i] = v
-            if resolved == "host":
+            if cacheable:
                 with _SIG_VERDICTS_LOCK:
                     for i, v in zip(misses, fresh):
                         _SIG_VERDICTS[checks[i]] = v
@@ -690,8 +758,12 @@ class TxVerifier:
             return False
         return True
 
-    async def collect_sig_checks(self, tx: Tx) -> Optional[List[tuple]]:
-        """Deferred signature tuples for this tx (None -> invalid)."""
+    async def collect_sig_checks(self, tx: Tx,
+                                 digests: Optional[tuple] = None
+                                 ) -> Optional[List[tuple]]:
+        """Deferred signature tuples for this tx (None -> invalid).
+        ``digests`` forwards a fused-prep (digest, digest_hexform) pair
+        so the per-tx sha256 passes are skipped (verify/block.py)."""
         is_revoke = tx.transaction_type in (
             TransactionType.REVOKE_AS_VALIDATOR, TransactionType.REVOKE_AS_DELEGATE)
         addresses = {}
@@ -700,7 +772,8 @@ class TxVerifier:
                     else await self.input_address(tx_input))
             addresses[tx_input.outpoint] = addr
         return _dedup_sig_checks(
-            tx, is_revoke, lambda i: addresses.get(i.outpoint))
+            tx, is_revoke, lambda i: addresses.get(i.outpoint),
+            digests=digests)
 
     async def verify(self, tx: Tx, check_double_spend: bool = True,
                      verifying_add_pending: bool = False,
