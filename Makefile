@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: lint test ruff metrics-check perf-observatory perf-smoke
+.PHONY: lint test ruff metrics-check perf-observatory perf-smoke swarm
 
 # Domain linter: consensus-endianness, consensus-purity, jit-purity,
 # dtype-hygiene, async-safety, broad-except.  Stdlib-only; exits 1 on
@@ -36,6 +36,14 @@ metrics-check:
 perf-observatory:
 	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.loadgen \
 		--out observatory.json --progress PROGRESS.jsonl
+
+# Deterministic multi-node scenario matrix (docs/SWARM.md): partition/
+# heal, reorg storm, eclipse, spam, DPoS governance, WS churn — all
+# in-process, seeded, a few seconds total.  Exit 1 if any core
+# assertion in any scenario came back false.
+swarm:
+	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.swarm --matrix fast \
+		--out swarm.json
 
 # CI-sized variant: tiny population, no PROGRESS append.  Gates
 # (report-only) against the committed artifact so every metric —

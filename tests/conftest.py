@@ -42,6 +42,13 @@ sys.path.insert(0, os.path.dirname(__file__))  # for `import ref_loader`
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running scenarios (50-node swarms, long partitions) "
+        "excluded from the tier-1 run via -m 'not slow'")
+
+
 @pytest.fixture(autouse=True)
 def _fresh_sig_verdicts():
     """The process-level signature-verdict cache must not leak verdicts

@@ -197,6 +197,12 @@ class WsConfig:
     heartbeat_interval: float = 30.0
     connection_expiry: float = 300.0
     cleanup_interval: float = 60.0  # idle-expiry sweep period
+    send_queue_max: int = 256       # bounded per-subscriber send queue;
+                                    # overflow sheds that subscriber's
+                                    # oldest pending message
+                                    # (drop-slowest) and counts it as
+                                    # upow_ws_dropped_messages; 0 =
+                                    # unbounded (never shed)
     channels: tuple = ("block", "transaction")
 
 

@@ -75,6 +75,19 @@ def _exact_quantile(sorted_lat: List[float], q: float) -> float:
     return sorted_lat[idx]
 
 
+def summarize_latencies(values: Sequence[float]) -> dict:
+    """Exact quantile summary over raw latency samples (seconds) — the
+    per-endpoint shape above, minus req/s; the swarm harness uses it
+    for per-node client-side SLO summaries."""
+    ordered = sorted(values)
+    return {
+        "requests": len(ordered),
+        "p50_ms": round(_exact_quantile(ordered, 0.50) * 1000, 4),
+        "p95_ms": round(_exact_quantile(ordered, 0.95) * 1000, 4),
+        "p99_ms": round(_exact_quantile(ordered, 0.99) * 1000, 4),
+    }
+
+
 def summarize(events: Sequence[LoadEvent],
               results: Sequence[Optional[ExecResult]],
               elapsed: float) -> dict:

@@ -172,6 +172,13 @@ class Node:
         self._background: set = set()
         self._http_session = None  # shared gossip/RPC session, lazy
         self.ws_hub = None  # set by ws.attach(...) when enabled
+        # Outbound RPC client seam: everything that talks to a peer
+        # builds its client through this factory (signature-compatible
+        # with NodeInterface).  The swarm harness swaps in a loopback
+        # implementation that routes through the in-memory LinkMatrix,
+        # so peer logic — breakers, retries, trace headers — runs
+        # unmodified over a simulated network (upow_tpu/swarm/).
+        self.iface_factory = NodeInterface
         self.app = self._build_app()
 
     # ----------------------------------------------------------- plumbing --
@@ -278,8 +285,9 @@ class Node:
         aws = []
         session = self._session()
         for node_url in nodes if nodes is not None else self.peers.propagate_nodes():
-            iface = NodeInterface(node_url, self.config.node, session=session,
-                                  resilience=self.resilience)
+            iface = self.iface_factory(node_url, self.config.node,
+                                       session=session,
+                                       resilience=self.resilience)
             if iface.base_url in (self_base, ignore_base):
                 continue
             aws.append(self._propagate_one(iface, path, args, self_base,
@@ -410,9 +418,9 @@ class Node:
             seeds = self.peers.recent_nodes()
             if not seeds:
                 return
-            iface = NodeInterface(seeds[0], self.config.node,
-                                  session=self._session(),
-                                  resilience=self.resilience)
+            iface = self.iface_factory(seeds[0], self.config.node,
+                                       session=self._session(),
+                                       resilience=self.resilience)
             for url in await iface.get_nodes():
                 self.peers.add(url)
             self.peers.remove(self.self_url)
@@ -622,6 +630,9 @@ class Node:
                       "WebSocket connections accepted since start")
             e.counter("ws_disconnects_total", ws["disconnects_total"],
                       "WebSocket connections dropped since start")
+            e.counter("ws_dropped_messages", ws["dropped_messages"],
+                      "Broadcast messages shed by per-subscriber bounded"
+                      " send queues (drop-slowest policy)")
         for state_name, count in sorted(self.breakers.state_counts().items()):
             e.gauge(f"breaker_{state_name}_peers", count,
                     f"Peers whose circuit breaker is {state_name}")
@@ -704,6 +715,16 @@ class Node:
             "ok": True,
             "result": telemetry.events.snapshot(limit=limit or None,
                                                 kind=kind)})
+
+    async def h_debug_breakers(self, request: web.Request) -> web.Response:
+        """Per-peer circuit state + EWMA health score, exactly what
+        gossip/sync peer ranking reads (PeerBook.ranked /
+        propagate_nodes) — so an operator (or a swarm assertion) can see
+        WHY a peer was skipped or tried last."""
+        return web.json_response({"ok": True, "result": {
+            "peers": self.breakers.snapshot(),
+            "state_counts": self.breakers.state_counts(),
+        }})
 
     async def h_debug_profile(self, request: web.Request) -> web.Response:
         """Opt-in jax.profiler capture control (ProfilingConfig):
@@ -1056,7 +1077,8 @@ class Node:
         # no resilience ctx: the probe of a candidate peer should stay a
         # quick single attempt and not seed a breaker entry for a URL we
         # may never admit to the book
-        iface = NodeInterface(url, self.config.node, session=self._session())
+        iface = self.iface_factory(url, self.config.node,
+                                   session=self._session())
         try:
             await iface.get("")
         except Exception as e:
@@ -1251,8 +1273,8 @@ class Node:
         """Fork detection + paged download (main.py:153-227), against one
         named peer."""
         cfg = self.config.node
-        iface = NodeInterface(node_url, cfg, session=self._session(),
-                              resilience=self.resilience)
+        iface = self.iface_factory(node_url, cfg, session=self._session(),
+                                   resilience=self.resilience)
         prefetch: Optional[asyncio.Task] = None
         prefetch_from = None
         try:
@@ -1602,6 +1624,7 @@ class Node:
         if self.config.telemetry.debug_endpoints:
             r.add_get("/debug/traces", self.h_debug_traces)
             r.add_get("/debug/events", self.h_debug_events)
+            r.add_get("/debug/breakers", self.h_debug_breakers)
             if self.config.profile.enabled:
                 r.add_get("/debug/profile", self.h_debug_profile)
         if self.config.ws.enabled:

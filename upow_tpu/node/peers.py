@@ -171,14 +171,23 @@ class PeerBook:
                   if meta.get("last_message", 0) == 0]
         picks = self._healthy_sample(active, k)
         picks += self._healthy_sample(unseen, k)
-        return picks
+        # Health-ranked fan-out, consistent with sync_blockchain's
+        # candidate ordering: the sampled set keeps the reference's
+        # gossip diversity, but sends go to the healthiest peers first
+        # so a degraded peer's slow/failing RPC is the last in line,
+        # not an equal-odds first pick.
+        return self.ranked(picks)
 
     def ranked(self, urls: List[str]) -> List[str]:
-        """Stable-sort candidate peers by descending health score with
-        open circuits pushed to the back (sync source ordering)."""
+        """Sort candidate peers by descending health score with open
+        circuits pushed to the back (sync source ordering / gossip
+        fan-out order).  Equal-health peers tie-break on URL so the
+        ordering is a pure function of breaker state — swarm scenarios
+        and operators replaying a /debug/breakers snapshot see the
+        same decision."""
         return sorted(urls, key=lambda u: (
             0 if self.breakers.usable(u) else 1,
-            -self.breakers.score(u)))
+            -self.breakers.score(u), u))
 
     def contains(self, url: str) -> bool:
         return _normalize(url) in self._data

@@ -118,8 +118,13 @@ class CircuitBreaker:
             self._opened_at = self._clock()
 
     def snapshot(self) -> dict:
+        """Observable state for /debug/breakers and swarm assertions:
+        the ranking inputs (state + EWMA score) plus the cumulative
+        flip count so "did this peer's circuit cycle during the
+        scenario" is a direct read, not a transition-log diff."""
         return {"state": self.state, "score": round(self.score, 4),
-                "consecutive_failures": self._consecutive_failures}
+                "consecutive_failures": self._consecutive_failures,
+                "flips": len(self.transitions) - 1}
 
 
 class BreakerRegistry:

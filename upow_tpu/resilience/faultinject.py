@@ -10,6 +10,13 @@ from a compact spec string (``ResilienceConfig.faults`` or
     device.verify:error:times=3     first three device verifies error
     ws.send:latency:delay=0.2       every ws send stalls 200 ms
     rpc:hang:times=1,delay=30       one RPC hangs 30 s (deadline food)
+    swarm.link:error:p=0.3          a third of simulated link transfers die
+
+Registered sites: ``rpc.<path>`` (peers.py, per peer RPC attempt),
+``ws.send`` (ws/hub.py, per outbound frame), ``device.verify``
+(txverify.py), and ``swarm.link`` (swarm/links.py — fires once per
+simulated transfer with key ``"src->dst"``, so ``key=`` can target one
+direction of one link).
 
 Sites are prefix-matched (``rpc`` matches ``rpc.get_blocks``); ``key``
 substring-filters the per-call key (usually the peer URL).  ``kind`` is

@@ -1,0 +1,25 @@
+"""In-process multi-node network simulator (ROADMAP item 5).
+
+Spins 10-50 REAL node apps — full middleware, breakers, mempool,
+telemetry — inside one event loop, with every peer RPC and WS frame
+routed through an in-memory :class:`LinkMatrix` that models per-link
+latency, jitter, drop, partitions and the ``swarm.link`` fault site.
+``node/app.py`` and ``node/peers.py`` run unmodified: the only seam is
+``Node.iface_factory``, swapped for :class:`LoopbackInterface`.
+
+On top sits a seeded scenario runner (:mod:`.scenarios`) with adversary
+actors (:mod:`.adversary`) and a DPoS governance traffic generator;
+each run emits a structured artifact whose deterministic core is
+fingerprinted — same seed, byte-identical fingerprint.
+
+    python -m upow_tpu.swarm --scenario partition_heal --nodes 10
+    python -m upow_tpu.swarm --matrix fast --out swarm.json
+
+See docs/SWARM.md for the scenario catalog and determinism contract.
+"""
+
+from .links import LinkDown, LinkMatrix, LinkPolicy  # noqa: F401
+from .transport import LoopbackHub, LoopbackInterface  # noqa: F401
+from .harness import Swarm  # noqa: F401
+from .scenarios import (SCENARIOS, artifact_fingerprint,  # noqa: F401
+                        run_matrix, run_scenario)

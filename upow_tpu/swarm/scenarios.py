@@ -1,0 +1,614 @@
+"""Seeded swarm scenarios + the deterministic artifact contract.
+
+Every scenario runs inside :func:`deterministic_world`: the consensus
+clock is frozen (advanced only by the mining helper), START_DIFFICULTY
+drops to 1.0 so the python searcher solves in microseconds, the global
+``random`` is seeded (peer sampling), telemetry rings are cleared and
+fault injection is uninstalled afterwards.  Wallet keys derive from
+``(seed, tag)``, so every address — and therefore every block hash —
+is a pure function of the seed.
+
+The artifact splits in two:
+
+* ``core`` — values that are a function of (scenario, seed) ONLY:
+  convergence flags, heights, tip hashes, governance ballots, shed
+  counts.  ``fingerprint`` is the sha256 of core's canonical JSON —
+  same seed, byte-identical fingerprint (pinned by tests).
+* ``observed`` — anything timing may wiggle: breaker snapshots, link
+  counters, retry/round counts, wall-clock.  Diagnostics, not
+  contract.
+
+``slo.endpoints`` carries per-node client-side latency quantiles in the
+exact shape the observatory gate's ``flatten()`` consumes, so swarm
+artifacts merge into the perf pipeline unchanged.
+
+See docs/SWARM.md for the catalog and determinism contract.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import hashlib
+import json
+import time
+from dataclasses import dataclass, field
+from decimal import Decimal
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import telemetry
+from ..logger import get_logger
+from ..resilience import faultinject
+from .harness import Swarm
+
+log = get_logger("swarm")
+
+#: Frozen consensus-clock epoch every scenario starts from.
+GENESIS_EPOCH = 1_753_791_000
+
+#: Real-time pause after a heal so tripped breakers can reach half-open
+#: (swarm_config pins breaker_open_secs=0.25; breakers run on monotonic
+#: wall time, not the frozen consensus clock).
+BREAKER_REOPEN_PAUSE = 0.35
+
+
+def _wallet(seed: int, tag: str) -> Tuple[int, str]:
+    """Deterministic (privkey, address) from (seed, tag)."""
+    from ..core import curve, point_to_string
+
+    digest = hashlib.sha256(f"swarm:{seed}:{tag}".encode()).digest()
+    d, pub = curve.keygen(rng=int.from_bytes(digest[:8], "big") | 1)
+    return d, point_to_string(pub)
+
+
+@contextlib.contextmanager
+def deterministic_world(seed: int):
+    """Pin every nondeterminism source a scenario touches."""
+    import random
+
+    from ..core import clock, difficulty
+
+    prev_difficulty = difficulty.START_DIFFICULTY
+    difficulty.START_DIFFICULTY = Decimal("1.0")
+    clock.freeze(GENESIS_EPOCH)
+    random.seed(seed)
+    telemetry.reset()
+    try:
+        yield
+    finally:
+        difficulty.START_DIFFICULTY = prev_difficulty
+        clock.reset()
+        faultinject.uninstall()
+
+
+# ------------------------------------------------------------- helpers ----
+
+async def _sync_from(swarm: Swarm, i: int, winner: int,
+                     tries: int = 50) -> dict:
+    """Drive node ``i`` to sync from ``winner``, absorbing the transient
+    'already syncing' race with background gossip-triggered syncs."""
+    res: dict = {}
+    for _ in range(tries):
+        res = await swarm.get(i, "sync_blockchain",
+                              {"node_url": swarm.urls[winner]})
+        if res.get("ok"):
+            return res
+        await asyncio.sleep(0.02)
+    return res
+
+
+def _breaker_flips(swarm: Swarm) -> int:
+    return sum(peer["flips"]
+               for snap in swarm.breaker_summary().values()
+               for peer in snap.values())
+
+
+def _roots_for(trace_id: str) -> List[dict]:
+    return [t for t in telemetry.traces()["recent"]
+            if t.get("trace_id") == trace_id]
+
+
+# ----------------------------------------------------------- scenarios ----
+
+async def scenario_partition_heal(swarm: Swarm, seed: int):
+    """2-way split mines divergent chains; heal; everyone converges on
+    the longer side; reorg + breaker evidence carries ONE trace id."""
+    n = swarm.n
+    everyone = list(range(n))
+    half = n // 2
+    a_idx, b_idx = everyone[:half], everyone[half:]
+    # the genesis-key rule (verify/block.py emission gate): with no
+    # inode ballot formed, ONLY block 1's miner address may mine — so
+    # both halves mine to the same key; the chains still diverge
+    # because the halves extend the fork at different (advancing)
+    # consensus timestamps
+    _, addr_shared = _wallet(seed, "shared")
+    addr_a = addr_b = addr_shared
+
+    # shared prefix deep enough for fork detection (window=4, tip>4)
+    for _ in range(4):
+        assert (await swarm.mine(0, addr_shared, push_to=everyone))["ok"]
+    await swarm.settle()
+    assert await swarm.converged(), "shared prefix did not converge"
+
+    swarm.matrix.partition([[swarm.urls[i] for i in a_idx],
+                            [swarm.urls[i] for i in b_idx]])
+    for _ in range(3):
+        assert (await swarm.mine(0, addr_a, push_to=a_idx))["ok"]
+    for _ in range(2):
+        assert (await swarm.mine(half, addr_b, push_to=b_idx))["ok"]
+    await swarm.settle()
+    tips = await swarm.tips()
+    diverged = len({t["hash"] for t in tips}) == 2
+    flips_during_partition = _breaker_flips(swarm)
+
+    swarm.matrix.heal()
+    await asyncio.sleep(BREAKER_REOPEN_PAUSE)
+    heal_results = []
+    with telemetry.request_trace("swarm.heal") as root:
+        heal_tid = root.trace_id
+        for i in b_idx:
+            heal_results.append(await _sync_from(swarm, i, winner=0))
+    await swarm.settle()
+    converged = await swarm.wait_converged()
+    tips = await swarm.tips()
+
+    reorgs = telemetry.events.snapshot(kind="reorg")
+    roots = _roots_for(heal_tid)
+    root_names = {t.get("name") for t in roots}
+    core = {
+        "diverged_during_partition": diverged,
+        "converged_after_heal": converged,
+        "final_height": tips[0]["id"],
+        "final_tip": tips[0]["hash"],
+        "losers_reorged": len(reorgs) >= len(b_idx),
+        "reorgs_share_heal_trace": bool(reorgs) and all(
+            e.get("trace_id") == heal_tid for e in reorgs),
+        # loser-side sync roots AND winner-side block-serving roots
+        # under one id: the trace crossed the swarm
+        "trace_spans_nodes": ("http.sync_blockchain" in root_names
+                              and "http.get_blocks" in root_names),
+        "breakers_flipped_during_partition": flips_during_partition > 0,
+    }
+    observed = {
+        "heal_trace_id": heal_tid,
+        "heal_results": heal_results,
+        "reorg_events": len(reorgs),
+        "heal_trace_roots": len(roots),
+        "breaker_flips": _breaker_flips(swarm),
+    }
+    return core, observed
+
+
+async def scenario_reorg_storm(swarm: Swarm, seed: int):
+    """Repeated partition/mine/heal cycles with the winning side
+    alternating — every cycle forces the previous winners to reorg."""
+    n = swarm.n
+    everyone = list(range(n))
+    half = n // 2
+    a_idx, b_idx = everyone[:half], everyone[half:]
+    a_urls = [swarm.urls[i] for i in a_idx]
+    b_urls = [swarm.urls[i] for i in b_idx]
+    _, addr_shared = _wallet(seed, "storm_base")
+
+    for _ in range(4):
+        assert (await swarm.mine(0, addr_shared, push_to=everyone))["ok"]
+    await swarm.settle()
+
+    cycles = []
+    for c in range(2):
+        a_wins = c % 2 == 0
+        # same genesis-key constraint as partition_heal: every block
+        # pays the block-1 miner until an inode ballot exists
+        addr_a = addr_b = addr_shared
+        swarm.matrix.partition([a_urls, b_urls])
+        for _ in range(3 if a_wins else 2):
+            assert (await swarm.mine(0, addr_a, push_to=a_idx))["ok"]
+        for _ in range(2 if a_wins else 3):
+            assert (await swarm.mine(half, addr_b, push_to=b_idx))["ok"]
+        await swarm.settle()
+        swarm.matrix.heal()
+        await asyncio.sleep(BREAKER_REOPEN_PAUSE)
+        winner = 0 if a_wins else half
+        for i in (b_idx if a_wins else a_idx):
+            await _sync_from(swarm, i, winner)
+        await swarm.settle()
+        converged = await swarm.wait_converged()
+        tips = await swarm.tips()
+        cycles.append({"cycle": c, "winner": "a" if a_wins else "b",
+                       "converged": converged,
+                       "height": tips[0]["id"], "tip": tips[0]["hash"]})
+
+    core = {
+        "cycles": cycles,
+        "all_converged": all(c["converged"] for c in cycles),
+        "reorged_every_cycle":
+            len(telemetry.events.snapshot(kind="reorg")) >= len(b_idx) * 2,
+    }
+    observed = {
+        "reorg_events": len(telemetry.events.snapshot(kind="reorg")),
+        "breaker_flips": _breaker_flips(swarm),
+    }
+    return core, observed
+
+
+async def scenario_eclipse(swarm: Swarm, seed: int):
+    """An adversary clique monopolises the victim's peer view; after the
+    unmask, breaker health resurfaces the honest peer and the victim
+    catches up — recovery earned through scores, not URL luck."""
+    from .adversary import EclipseAdversary
+
+    n = swarm.n
+    victim, honest_idx = 0, list(range(1, n))
+    honest_url = swarm.urls[1]
+    adv = EclipseAdversary(swarm.hub, count=3)
+    _, addr = _wallet(seed, "eclipse_miner")
+
+    # peer views: honest nodes mesh among themselves (no victim); the
+    # victim knows the clique plus ONE honest peer
+    for i in honest_idx:
+        for j in honest_idx:
+            if i != j:
+                swarm.nodes[i].peers.add(swarm.urls[j])
+    for url in adv.urls:
+        swarm.nodes[victim].peers.add(url)
+    swarm.nodes[victim].peers.add(honest_url)
+
+    for _ in range(2):
+        assert (await swarm.mine(1, addr,
+                                 push_to=list(range(n))))["ok"]
+    await swarm.settle()
+    assert await swarm.converged(), "pre-eclipse prefix did not converge"
+
+    # eclipse on: victim + clique on one side, honest on the other
+    swarm.matrix.partition([[swarm.urls[victim]] + adv.urls,
+                            [swarm.urls[i] for i in honest_idx]])
+    for _ in range(2):
+        assert (await swarm.mine(1, addr, push_to=honest_idx))["ok"]
+    await swarm.settle()
+    eclipse_syncs = []
+    for _ in range(3):
+        eclipse_syncs.append(await swarm.get(victim, "sync_blockchain"))
+    tips = await swarm.tips()
+    eclipsed = tips[victim]["id"] < tips[1]["id"]
+
+    # the attack ends: clique goes dark, links restore
+    adv.unmask()
+    swarm.matrix.heal()
+    await asyncio.sleep(BREAKER_REOPEN_PAUSE)
+    recovery_rounds = 0
+    for _ in range(12):
+        recovery_rounds += 1
+        await swarm.get(victim, "sync_blockchain")
+        tips = await swarm.tips()
+        if tips[victim]["hash"] == tips[1]["hash"]:
+            break
+        await asyncio.sleep(0.05)
+    recovered = tips[victim]["hash"] == tips[1]["hash"]
+
+    # keep syncing until health ranking surfaces the honest peer first
+    # (each round adds an honest success or an adversary failure, so
+    # the ordering is monotone toward honest-first)
+    peers = swarm.nodes[victim].peers
+    ranked_rounds = 0
+    for _ in range(20):
+        if peers.ranked(peers.all_nodes())[0] == honest_url:
+            break
+        ranked_rounds += 1
+        await swarm.get(victim, "sync_blockchain")
+        await asyncio.sleep(0.02)
+    ranked_first = peers.ranked(peers.all_nodes())[0]
+    breakers = swarm.nodes[victim].breakers
+    core = {
+        "eclipsed": eclipsed,
+        "recovered": recovered,
+        "victim_height": tips[victim]["id"],
+        "victim_tip": tips[victim]["hash"],
+        "honest_ranked_first": ranked_first == honest_url,
+        "adversaries_scored_below_honest": all(
+            breakers.score(u) < breakers.score(honest_url)
+            for u in adv.urls),
+        "adversary_served_calls": adv.calls - adv.calls_after_unmask > 0,
+    }
+    observed = {
+        "eclipse_syncs": eclipse_syncs,
+        "recovery_rounds": recovery_rounds,
+        "ranked_rounds": ranked_rounds,
+        "adversary_calls": adv.calls,
+        "adversary_calls_after_unmask": adv.calls_after_unmask,
+        "victim_breakers": breakers.snapshot(),
+    }
+    return core, observed
+
+
+async def scenario_spam(swarm: Swarm, seed: int):
+    """A flooder pushes garbage + duplicate transactions at every node;
+    pools stay clean (one honest tx), mining and convergence survive."""
+    from ..wallet.builders import WalletBuilder
+    from .adversary import SpamAdversary
+
+    n = swarm.n
+    everyone = list(range(n))
+    d_f, addr_f = _wallet(seed, "spam_funder")
+    _, addr_t = _wallet(seed, "spam_target")
+
+    assert (await swarm.mine(0, addr_f, push_to=everyone))["ok"]
+    await swarm.settle()
+    builder = WalletBuilder(swarm.nodes[0].state)
+    tx = await builder.create_transaction(d_f, addr_t, "1")
+
+    spam = SpamAdversary(swarm.hub)
+    await spam.flood_garbage(swarm.urls, 40)
+    res = await swarm.get(0, "push_tx", {"tx_hex": tx.hex()})
+    assert res.get("ok"), res
+    await swarm.settle()  # gossip carries the honest tx everywhere
+    await spam.flood_duplicates(swarm.urls, tx.hex(), 24)
+    await swarm.settle()
+
+    pools = []
+    for i in everyone:
+        res = await swarm.get(i, "get_pending_transactions")
+        pools.append(res["result"])
+    assert (await swarm.mine(0, addr_f, push_to=everyone))["ok"]
+    await swarm.settle()
+    converged = await swarm.wait_converged()
+    confirm = await swarm.get(n - 1, "get_transaction",
+                              {"tx_hash": tx.hash()})
+    tips = await swarm.tips()
+    core = {
+        "spam_sent": spam.sent,
+        "spam_accepted": spam.accepted,
+        "pools_clean": all(p == [tx.hex()] for p in pools),
+        "tx_confirmed_everywhere": bool(
+            confirm.get("ok") and confirm["result"]["is_confirm"]),
+        "converged": converged,
+        "final_height": tips[0]["id"],
+        "final_tip": tips[0]["hash"],
+    }
+    observed = {
+        "spam_rejected": spam.rejected,
+        "pool_depths": [len(p) for p in pools],
+    }
+    return core, observed
+
+
+async def scenario_dpos_governance(swarm: Swarm, seed: int):
+    """The full DPoS flow through the node API: stake → delegate vote →
+    validator registration → inode registration → validator vote →
+    a mined block whose coinbase splits 50/50 miner/inode — then a
+    fresh node syncs the whole governance history."""
+    from ..core.rewards import get_block_reward_decimal
+    from ..wallet.builders import WalletBuilder
+
+    d_g, a_g = _wallet(seed, "gov_validator")
+    d_o, a_o = _wallet(seed, "gov_delegate")
+    d_i, a_i = _wallet(seed, "gov_inode")
+    builder = WalletBuilder(swarm.nodes[0].state)
+
+    async def push(tx) -> None:
+        res = await swarm.get(0, "push_tx", {"tx_hex": tx.hex()})
+        assert res.get("ok"), res
+
+    async def mine() -> None:
+        assert (await swarm.mine(0, a_g))["ok"]
+
+    for _ in range(22):            # validator registration needs 100
+        await mine()
+    await push(await builder.create_stake_transaction(d_g, "3"))
+    await mine()
+    await push(await builder.create_validator_registration_transaction(d_g))
+    await mine()
+    await push(await builder.create_transaction(d_g, a_o, "20"))
+    await mine()
+    await push(await builder.create_stake_transaction(d_o, "1"))
+    await mine()
+    await push(await builder.vote_as_delegate(d_o, 10, a_g))
+    await mine()
+
+    for _ in range(170):           # inode registration needs 1000
+        await mine()
+    for chunk in ("400", "400", "210"):   # <256 inputs per send
+        await push(await builder.create_transaction(d_g, a_i, chunk))
+        await mine()
+    await push(await builder.create_stake_transaction(d_i, "1"))
+    await mine()
+    await push(await builder.create_inode_registration_transaction(d_i))
+    await mine()
+    await push(await builder.vote_as_validator(d_g, 10, a_i))
+    await mine()
+
+    validators = await swarm.get(0, "get_validators_info")
+    delegates = await swarm.get(0, "get_delegates_info")
+    dobby = await swarm.get(0, "dobby_info")
+
+    # the reward-split block: empty mempool, so the only balance change
+    # on the inode address is its coinbase share
+    before = Decimal((await swarm.get(
+        0, "get_address_info", {"address": a_i}))["result"]["balance"])
+    await mine()
+    after = Decimal((await swarm.get(
+        0, "get_address_info", {"address": a_i}))["result"]["balance"])
+    tips = await swarm.tips()
+    height = tips[0]["id"]
+    reward = get_block_reward_decimal(height)
+    inode_share = after - before
+    split_ok = inode_share == reward * Decimal("0.5")
+
+    # a blank node replays the whole governance history from genesis
+    sync = await _sync_from(swarm, 1, winner=0)
+    converged = await swarm.converged()
+    utxo_match = (await swarm.nodes[0].state.get_unspent_outputs_hash()
+                  == await swarm.nodes[1].state.get_unspent_outputs_hash())
+    core = {
+        "validator": a_g,
+        "delegate_votes": [
+            {"delegate": d["delegate"],
+             "voted_for": [v["wallet"] for v in d["vote"]],
+             "total_stake": str(d["totalStake"])}
+            for d in delegates],
+        "inode_ballot": [
+            {"validator": v["validator"],
+             "voted_for": [x["wallet"] for x in v["vote"]]}
+            for v in validators],
+        "dobby_emissions": dobby.get("result"),
+        "final_height": height,
+        "final_tip": tips[0]["hash"],
+        "block_reward": str(reward),
+        "inode_coinbase_share": str(inode_share),
+        "split_50_50": split_ok,
+        "fresh_node_synced": bool(sync.get("ok")) and converged,
+        "utxo_fingerprints_match": utxo_match,
+    }
+    observed = {"sync_result": sync}
+    return core, observed
+
+
+async def scenario_ws_churn(swarm: Swarm, seed: int):
+    """A stalled WS subscriber must not block fan-out: the live client
+    sees every block while the stalled one's bounded queue sheds oldest
+    — counted and exported as upow_ws_dropped_messages."""
+    from .transport import LoopbackWsClient
+
+    _, addr = _wallet(seed, "ws_miner")
+    hub = swarm.nodes[0].ws_hub
+    assert hub is not None, "ws_churn needs ws=True"
+    live = LoopbackWsClient()
+    slow = LoopbackWsClient()
+    hub.connect_local(live, ip="10.99.0.1", channels=("block",))
+    hub.connect_local(slow, ip="10.99.0.2", channels=("block",))
+    slow.stall()
+
+    for _ in range(8):
+        assert (await swarm.mine(0, addr,
+                                 push_to=list(range(swarm.n))))["ok"]
+        # the broadcast is a spawned task: drain it (and give the
+        # writer a real suspension point) per block, as a socket would
+        await swarm.settle()
+        await asyncio.sleep(0.005)
+    for _ in range(200):           # writer task drains asynchronously
+        if len(live.of_type("new_block")) >= 8:
+            break
+        await asyncio.sleep(0.01)
+    slow.resume()
+    for _ in range(200):
+        if hub.get_stats()["dropped_messages"] >= 3 and \
+                len(slow.of_type("new_block")) >= 5:
+            break
+        await asyncio.sleep(0.01)
+
+    status, body = await swarm.hub.request(
+        swarm.driver, swarm.urls[0], "GET", "/metrics")
+    text = body.decode()
+    dropped = hub.get_stats()["dropped_messages"]
+    metric_line = next(
+        (ln for ln in text.splitlines()
+         if ln.startswith("upow_ws_dropped_messages_total ")), "")
+    tips = await swarm.tips()
+    core = {
+        "blocks_broadcast": 8,
+        "live_client_delivered": len(live.of_type("new_block")),
+        "slow_client_delivered": len(slow.of_type("new_block")),
+        "dropped_messages": dropped,
+        "metrics_export_dropped": bool(metric_line) and
+            float(metric_line.split()[1]) == dropped,
+        "final_height": tips[0]["id"],
+        "final_tip": tips[0]["hash"],
+    }
+    observed = {"metrics_status": status,
+                "ws_stats": hub.get_stats()}
+    return core, observed
+
+
+# ------------------------------------------------------------- registry ----
+
+@dataclass(frozen=True)
+class ScenarioSpec:
+    fn: Callable
+    nodes: int                # default swarm size
+    fast: bool                # member of the CI fast matrix
+    topology: str = "mesh"
+    swarm_kwargs: dict = field(default_factory=dict)
+
+
+SCENARIOS: Dict[str, ScenarioSpec] = {
+    "partition_heal": ScenarioSpec(
+        scenario_partition_heal, nodes=6, fast=True,
+        swarm_kwargs={"reorg_window": 4}),
+    "reorg_storm": ScenarioSpec(
+        scenario_reorg_storm, nodes=6, fast=True,
+        swarm_kwargs={"reorg_window": 4}),
+    "eclipse": ScenarioSpec(
+        scenario_eclipse, nodes=4, fast=True, topology="isolated"),
+    "spam": ScenarioSpec(scenario_spam, nodes=4, fast=True),
+    "dpos_governance": ScenarioSpec(
+        scenario_dpos_governance, nodes=2, fast=True,
+        topology="isolated"),
+    "ws_churn": ScenarioSpec(
+        scenario_ws_churn, nodes=2, fast=True,
+        swarm_kwargs={"ws": True, "ws_queue_max": 4}),
+}
+
+
+# ------------------------------------------------------------- artifact ----
+
+def artifact_fingerprint(core: dict) -> str:
+    """sha256 over core's canonical JSON — THE determinism contract."""
+    blob = json.dumps(core, sort_keys=True, separators=(",", ":"),
+                      default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+async def _drive(spec: ScenarioSpec, n: int, seed: int):
+    swarm = Swarm(n, seed=seed, **spec.swarm_kwargs)
+    await swarm.start(topology=spec.topology)
+    try:
+        core, observed = await spec.fn(swarm, seed)
+        observed = dict(observed)
+        observed["links"] = swarm.matrix.stats()
+        observed["breakers"] = swarm.breaker_summary()
+        slo = swarm.slo_summary()
+    finally:
+        await swarm.close()
+    return core, observed, slo
+
+
+def run_scenario(name: str, nodes: Optional[int] = None,
+                 seed: int = 7) -> dict:
+    """Run one scenario inside a deterministic world; return the
+    artifact (core + fingerprint + observed + gate-shaped slo)."""
+    spec = SCENARIOS[name]
+    n = nodes or spec.nodes
+    t0 = time.perf_counter()
+    with deterministic_world(seed):
+        core, observed, slo = asyncio.run(_drive(spec, n, seed))
+    elapsed = time.perf_counter() - t0
+    core = {"scenario": name, "seed": seed, "nodes": n, **core}
+    observed["elapsed_s"] = round(elapsed, 3)
+    log.info("scenario %s (n=%d seed=%d) done in %.2fs", name, n, seed,
+             elapsed)
+    return {
+        "kind": "swarm_scenario",
+        "scenario": name,
+        "seed": seed,
+        "nodes": n,
+        "core": core,
+        "fingerprint": artifact_fingerprint(core),
+        "observed": observed,
+        "slo": {"endpoints": {f"swarm.{name}.{node}": row
+                              for node, row in slo.items()}},
+    }
+
+
+def run_matrix(which: str = "fast", seed: int = 7) -> dict:
+    """Run every (fast) scenario at its default size; the matrix
+    fingerprint chains the per-scenario fingerprints in name order."""
+    runs = []
+    for name in sorted(SCENARIOS):
+        if which != "all" and not SCENARIOS[name].fast:
+            continue
+        runs.append(run_scenario(name, seed=seed))
+    chained = hashlib.sha256(
+        "".join(r["fingerprint"] for r in runs).encode()).hexdigest()
+    return {"kind": "swarm_matrix", "which": which, "seed": seed,
+            "scenarios": [r["scenario"] for r in runs],
+            "fingerprint": chained, "runs": runs}

@@ -1,0 +1,241 @@
+"""In-memory HTTP/WS transport: real node apps, zero sockets.
+
+:class:`LoopbackHub` is the wire.  A dispatch builds an aiohttp request
+object (mocked transport carrying the caller's simulated IP, a real
+StreamReader for POST bodies) and hands it to the destination app's own
+``_handle`` — the full middleware chain, routing, rate limiter, IP
+filter and handlers run exactly as they would behind a socket, and the
+response body comes back as bytes.
+
+:class:`LoopbackInterface` subclasses the production
+:class:`~upow_tpu.node.peers.NodeInterface` and overrides ONLY the two
+attempt closures (``request``/``get``): the breaker gate, fault
+injection, retry policy, Sender-Node and X-Upow-Trace headers all run
+through the inherited ``_resilient``/``_rpc_headers`` code.  A link
+failure (:class:`~.links.LinkDown`) is a ``ConnectionError``, so peers
+see retries, breaker flips and health-score decay with no node change.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import urllib.parse
+from typing import Awaitable, Callable, Dict, Optional, Tuple
+
+from aiohttp import streams, web
+from aiohttp.test_utils import make_mocked_request
+
+from ..logger import get_logger
+from ..node.peers import NodeInterface, _normalize
+from .links import LinkMatrix
+
+log = get_logger("swarm")
+
+# an adversary endpoint: (method, path, params, json_body) -> (status, doc)
+RawHandler = Callable[[str, str, dict, Optional[dict]],
+                      Awaitable[Tuple[int, dict]]]
+
+
+class _StreamProtocol:
+    """Protocol stub keeping StreamReader flow control inert (the
+    mocked request's payload has no real transport behind it)."""
+
+    _reading_paused = False
+    transport = None
+
+    def pause_reading(self) -> None:
+        pass
+
+    def resume_reading(self) -> None:
+        pass
+
+
+class _FakeTransport:
+    """Just enough transport for ``request.transport.get_extra_info``
+    — the middleware reads the peer IP from ``peername``."""
+
+    def __init__(self, peername: Tuple[str, int]):
+        self._peername = peername
+
+    def get_extra_info(self, name: str, default=None):
+        return self._peername if name == "peername" else default
+
+
+class LoopbackHub:
+    """URL -> in-process listener registry + request dispatch."""
+
+    def __init__(self, matrix: LinkMatrix):
+        self.matrix = matrix
+        self._nodes: Dict[str, object] = {}
+        self._raw: Dict[str, RawHandler] = {}
+        self._ips: Dict[str, str] = {}
+        # client-side latency per (dst url, path): the per-node SLO
+        # source — node-side telemetry is process-global in the swarm,
+        # so per-destination numbers must be measured at the caller
+        self.latencies: Dict[Tuple[str, str], list] = {}
+
+    def register_node(self, url: str, node, ip: str) -> None:
+        base = _normalize(url)
+        self._nodes[base] = node
+        self._ips[base] = ip
+        self.matrix.register(base)
+
+    def register_raw(self, url: str, handler: RawHandler,
+                     ip: str = "") -> None:
+        """Attach an adversary endpoint: answers RPCs without being a
+        node (or raises to model a dead peer)."""
+        base = _normalize(url)
+        self._raw[base] = handler
+        if ip:
+            self._ips[base] = ip
+        self.matrix.register(base)
+
+    def register_client(self, url: str, ip: str) -> None:
+        """A shaped client endpoint (e.g. a spammer): pays link tolls
+        and carries a simulated source IP, but serves nothing."""
+        base = _normalize(url)
+        self._ips[base] = ip
+        self.matrix.register(base)
+
+    def node(self, url: str):
+        return self._nodes[_normalize(url)]
+
+    async def request(self, src: str, dst: str, method: str, path: str,
+                      params: Optional[dict] = None,
+                      json_body: Optional[dict] = None,
+                      headers: Optional[dict] = None) -> Tuple[int, bytes]:
+        """One simulated HTTP exchange src -> dst.  Raises LinkDown /
+        ConnectionRefusedError for network-level failure; application
+        errors come back as (status, body) like real HTTP."""
+        src_base, base = _normalize(src), _normalize(dst)
+        await self.matrix.transfer(src_base, base)
+        raw = self._raw.get(base)
+        if raw is not None:
+            status, doc = await raw(method, path, dict(params or {}),
+                                    json_body)
+            return status, json.dumps(doc).encode()
+        node = self._nodes.get(base)
+        if node is None:
+            raise ConnectionRefusedError(f"no swarm listener at {dst}")
+
+        path_qs = path
+        if params:
+            path_qs += "?" + urllib.parse.urlencode(params)
+        hdrs = {"Host": base.split("://", 1)[-1]}
+        if headers:
+            hdrs.update(headers)
+        body = b""
+        if json_body is not None:
+            body = json.dumps(json_body).encode()
+            hdrs.setdefault("Content-Type", "application/json")
+            hdrs["Content-Length"] = str(len(body))
+        payload = streams.StreamReader(_StreamProtocol(), limit=2 ** 16,
+                                       loop=asyncio.get_event_loop())
+        if body:
+            payload.feed_data(body)
+        payload.feed_eof()
+        req = make_mocked_request(
+            method, path_qs, headers=hdrs, payload=payload, app=node.app,
+            transport=_FakeTransport(
+                (self._ips.get(src_base, "127.0.0.1"), 40000)))
+        t0 = time.perf_counter()
+        try:
+            resp = await node.app._handle(req)
+        except web.HTTPException as e:
+            resp = e  # an HTTPException IS a Response in aiohttp
+        self.latencies.setdefault((base, path), []).append(
+            time.perf_counter() - t0)
+        out = resp.body
+        if out is None:
+            out = b""
+        elif not isinstance(out, (bytes, bytearray)):
+            out = (resp.text or "").encode()
+        return resp.status, bytes(out)
+
+
+class LoopbackInterface(NodeInterface):
+    """NodeInterface whose wire is the LoopbackHub."""
+
+    def __init__(self, hub: LoopbackHub, src: str, url: str, cfg=None,
+                 session=None, resilience=None):
+        # session is accepted for factory-signature parity and ignored:
+        # there is no socket pool to share
+        super().__init__(url, cfg, session=None, resilience=resilience)
+        self._hub = hub
+        self._src = src
+
+    async def _call(self, method: str, path: str,
+                    params: Optional[dict] = None,
+                    json_body: Optional[dict] = None,
+                    headers: Optional[dict] = None) -> dict:
+        _, body = await self._hub.request(
+            self._src, self.base_url, method, "/" + path.lstrip("/"),
+            params=params, json_body=json_body, headers=headers)
+        if len(body) > self.cfg.response_cap:
+            raise ValueError("response too large")
+        return json.loads(body or b"{}")
+
+    async def request(self, path: str, args: dict,
+                      sender_node: str = "") -> dict:
+        headers = self._rpc_headers(sender_node)
+
+        async def attempt() -> dict:
+            if path in ("push_block", "push_tx"):
+                return await self._call("POST", path, json_body=args,
+                                        headers=headers)
+            params = {k: str(v) for k, v in args.items()}
+            return await self._call("GET", path, params=params,
+                                    headers=headers)
+
+        return await self._resilient(attempt, path)
+
+    async def get(self, path: str, params: Optional[dict] = None,
+                  sender_node: str = "") -> dict:
+        headers = self._rpc_headers(sender_node)
+
+        async def attempt() -> dict:
+            return await self._call("GET", path, params=params or {},
+                                    headers=headers)
+
+        return await self._resilient(attempt, path)
+
+
+class LoopbackWsClient:
+    """In-process WS subscriber sink for ``WsHub.connect_local``: the
+    hub's writer task calls ``send_str``; frames land in ``received``.
+    ``stall()`` models a consumer whose socket never drains — the
+    writer blocks here while the connection's bounded queue sheds —
+    and an optional (matrix, node, url) triple routes frames through
+    swarm links so partitions cut WS push too."""
+
+    def __init__(self, matrix: Optional[LinkMatrix] = None,
+                 node_url: str = "", url: str = ""):
+        self.received: list = []
+        self._matrix = matrix
+        self._node_url = _normalize(node_url)
+        self._url = _normalize(url)
+        if matrix is not None and self._url:
+            matrix.register(self._url)
+        self._stalled = False
+        self._resume = asyncio.Event()
+        self._resume.set()
+
+    def stall(self) -> None:
+        self._stalled = True
+        self._resume.clear()
+
+    def resume(self) -> None:
+        self._stalled = False
+        self._resume.set()
+
+    async def send_str(self, payload: str) -> None:
+        if self._stalled:
+            await self._resume.wait()
+        if self._matrix is not None and self._url:
+            await self._matrix.transfer(self._node_url, self._url)
+        self.received.append(json.loads(payload))
+
+    def of_type(self, mtype: str) -> list:
+        return [m for m in self.received if m.get("type") == mtype]

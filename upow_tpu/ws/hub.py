@@ -15,6 +15,15 @@ routes it (socket_handlers.py:23-31) but forgot it in
 ALLOWED_MESSAGE_TYPES (socket_config.py:18-23), making it unreachable —
 an evident bug, fixed rather than replicated since no working reference
 client can depend on the broken behavior.
+
+Delivery is decoupled from broadcast: every connection owns a bounded
+send queue drained by a per-connection writer task, so one stalled
+subscriber (full TCP window, hung middlebox) can NEVER block the
+broadcast fan-out to everyone else.  Overflow sheds the OLDEST queued
+message for that subscriber (drop-slowest: the laggard loses history,
+live clients lose nothing) and counts it — exported as
+``upow_ws_dropped_messages`` on /metrics.  A failed wire write reaps
+the connection from the writer, exactly like the old inline reap.
 """
 
 from __future__ import annotations
@@ -23,6 +32,7 @@ import asyncio
 import json
 import time
 import uuid
+from collections import deque
 from datetime import datetime, timezone
 from typing import Dict, Optional, Set
 
@@ -42,7 +52,8 @@ _SUBSCRIBE = {
 
 
 class WsConnection:
-    """Per-connection state: socket, subscriptions, rate bucket, stats."""
+    """Per-connection state: socket, subscriptions, rate bucket, stats,
+    and the bounded send queue its writer task drains."""
 
     def __init__(self, ws: web.WebSocketResponse, ip: str, cfg: WsConfig):
         self.id = uuid.uuid4().hex[:12]
@@ -56,7 +67,14 @@ class WsConnection:
         self.messages_out = 0
         self.bytes_in = 0
         self.bytes_out = 0
+        self.dropped = 0            # messages shed by queue overflow
         self._bucket_times: list = []
+        # 0 = unbounded (never shed); the deque IS the queue, the event
+        # signals the writer — a plain asyncio.Queue cannot drop-oldest
+        self._queue: deque = deque(
+            maxlen=cfg.send_queue_max if cfg.send_queue_max > 0 else None)
+        self._queue_event = asyncio.Event()
+        self._closed = False
 
     def rate_ok(self) -> bool:
         now = time.monotonic()
@@ -67,6 +85,26 @@ class WsConnection:
         return True
 
     async def send(self, message: dict) -> bool:
+        """Enqueue for the writer task; never blocks on the socket.  A
+        full queue sheds this subscriber's OLDEST pending message
+        (drop-slowest).  Returns False once the connection is closed."""
+        if self._closed:
+            return False
+        if self._queue.maxlen and len(self._queue) == self._queue.maxlen:
+            self._queue.popleft()  # deque would do this silently; count it
+            self.dropped += 1
+        self._queue.append(message)
+        self._queue_event.set()
+        return True
+
+    async def _next_queued(self) -> dict:
+        while not self._queue:
+            self._queue_event.clear()
+            await self._queue_event.wait()
+        return self._queue.popleft()
+
+    async def _send_now(self, message: dict) -> bool:
+        """The actual wire write (writer task only)."""
         try:
             from ..resilience.faultinject import get_injector
 
@@ -99,11 +137,14 @@ class WsHub:
         self.by_ip: Dict[str, Set[str]] = {}
         self.channels: Dict[str, Set[str]] = {c: set() for c in self.cfg.channels}
         self._loops_started = False
+        self._loop_tasks: Set[asyncio.Task] = set()
+        self._writers: Dict[str, asyncio.Task] = {}
         # cumulative lifecycle counters: get_stats() sums over LIVE
         # connections only, so subscriber churn (the loadgen's ws
         # scenario) was invisible before these
         self.connects_total = 0
         self.disconnects_total = 0
+        self.dropped_total = 0  # includes shed counts of reaped conns
 
     # ------------------------------------------------------------ endpoint --
     async def handle(self, request: web.Request) -> web.WebSocketResponse:
@@ -120,10 +161,7 @@ class WsHub:
             max_msg_size=self.cfg.max_message_bytes)
         await ws.prepare(request)
         conn = WsConnection(ws, ip, self.cfg)
-        self.connections[conn.id] = conn
-        self.by_ip.setdefault(ip, set()).add(conn.id)
-        self.connects_total += 1
-        self._ensure_loops()
+        self._register(conn)
         log.info("ws connect %s from %s (%d total)", conn.id, ip,
                  len(self.connections))
         await conn.send({"type": "connection_established",
@@ -184,11 +222,51 @@ class WsHub:
         await conn.send_error("INVALID_MESSAGE_TYPE",
                               f"Message type '{mtype}' not allowed")
 
+    def _register(self, conn: WsConnection) -> None:
+        self.connections[conn.id] = conn
+        self.by_ip.setdefault(conn.ip, set()).add(conn.id)
+        self.connects_total += 1
+        self._ensure_loops()
+        self._writers[conn.id] = asyncio.ensure_future(self._writer(conn))
+
+    async def _writer(self, conn: WsConnection) -> None:
+        """Drain one connection's send queue onto the wire.  A failed
+        write means a dead subscriber: reap it here, exactly like the
+        old inline broadcast reap, without ever stalling the hub."""
+        while True:
+            message = await conn._next_queued()
+            if not await conn._send_now(message):
+                self._writers.pop(conn.id, None)  # self-reap: don't
+                self._drop(conn)                  # cancel ourselves
+                return
+
+    def connect_local(self, sink, ip: str = "local",
+                      channels: tuple = ()) -> WsConnection:
+        """Attach an in-process subscriber (swarm WS-churn scenarios,
+        loadgen) — ``sink`` needs only ``async send_str(payload)``.
+        Returns the registered connection; detach with ``drop()``."""
+        conn = WsConnection(sink, ip, self.cfg)
+        self._register(conn)
+        for channel in channels:
+            if channel in self.channels:
+                conn.channels.add(channel)
+                self.channels[channel].add(conn.id)
+        return conn
+
+    def drop(self, conn: WsConnection) -> None:
+        """Public detach for connect_local subscribers."""
+        self._drop(conn)
+
     def _drop(self, conn: WsConnection) -> None:
         if self.connections.pop(conn.id, None) is not None:
             # count once even when the reap path and the handler's
             # finally both drop the same connection
             self.disconnects_total += 1
+            self.dropped_total += conn.dropped
+        conn._closed = True
+        writer = self._writers.pop(conn.id, None)
+        if writer is not None:
+            writer.cancel()
         self.by_ip.get(conn.ip, set()).discard(conn.id)
         if not self.by_ip.get(conn.ip):
             self.by_ip.pop(conn.ip, None)
@@ -197,8 +275,11 @@ class WsHub:
 
     # ----------------------------------------------------------- broadcast --
     async def broadcast_to_channel(self, channel: str, message: dict) -> int:
-        """Send to every subscriber; reap dead connections
-        (reference socket_manager.py:201-231)."""
+        """Enqueue to every subscriber (reference
+        socket_manager.py:201-231).  Returns the number of subscribers
+        the message was queued for; wire delivery and dead-subscriber
+        reaping happen in the per-connection writers, so a stalled
+        client costs the broadcast nothing."""
         sent = 0
         for conn_id in list(self.channels.get(channel, ())):
             conn = self.connections.get(conn_id)
@@ -228,8 +309,18 @@ class WsHub:
         if self._loops_started:
             return
         self._loops_started = True
-        asyncio.ensure_future(self._cleanup_loop())
-        asyncio.ensure_future(self._stats_loop())
+        self._loop_tasks.add(asyncio.ensure_future(self._cleanup_loop()))
+        self._loop_tasks.add(asyncio.ensure_future(self._stats_loop()))
+
+    def close(self) -> None:
+        """Drop every connection and cancel lifecycle/writer tasks
+        (swarm teardown; a live server keeps the hub for its lifetime)."""
+        for conn in list(self.connections.values()):
+            self._drop(conn)
+        for task in self._loop_tasks:
+            task.cancel()
+        self._loop_tasks.clear()
+        self._loops_started = False
 
     async def _cleanup_loop(self) -> None:
         """Expire idle connections (reference socket_manager.py:333-352)."""
@@ -260,6 +351,8 @@ class WsHub:
             "messages_in": sum(c.messages_in for c in self.connections.values()),
             "connects_total": self.connects_total,
             "disconnects_total": self.disconnects_total,
+            "dropped_messages": self.dropped_total + sum(
+                c.dropped for c in self.connections.values()),
         }
 
     def get_detailed_stats(self) -> dict:
