@@ -47,8 +47,10 @@ swarm:
 
 # CI-sized variant: tiny population, no PROGRESS append.  Gates
 # (report-only) against the committed artifact so every metric —
-# including verify_pipeline with its explicit higher-is-better
-# direction — is registered with gate.py on each smoke run.
+# including verify_pipeline and the readpath cache scenario with their
+# explicit direction metadata — is registered with gate.py on each
+# smoke run.  The readpath headline zeroes itself (tripping the gate)
+# if its cached-vs-recomputed byte differential ever diverges.
 perf-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.loadgen --smoke \
 		--out observatory-smoke.json \

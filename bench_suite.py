@@ -18,6 +18,8 @@ configs, one JSON line each.
 11. perf observatory: wallet-population loadgen SLO + kernel artifact
 12. verify_pipeline: pipelined verify engine (coalesced front + verdict
     cache, steady state) vs serial per-tx host dispatch + differential
+13. readpath: block-anchored hot-state read cache vs the bypassed SQL
+    path under block cadence, byte-identity differential built in
 
 ``bench.py`` stays the driver-facing single-line headline (sha256
 search + the verify sub-metric); this suite is the full scoreboard.
@@ -52,11 +54,17 @@ def _platform() -> str:
     return _PLATFORM
 
 
-def _emit(metric, value, unit, baseline):
-    print(json.dumps({
+def _emit(metric, value, unit, baseline, direction=None):
+    line = {
         "metric": metric, "value": round(value, 3), "unit": unit,
         "vs_baseline": round(value / baseline, 1) if baseline else None,
-    }), flush=True)
+    }
+    if direction:
+        # explicit gate direction (upow_tpu.loadgen.gate honors it over
+        # its name inference — "speedup_p99" would otherwise read as a
+        # latency)
+        line["direction"] = direction
+    print(json.dumps(line), flush=True)
 
 
 def _python_loop_mhs(prefix: bytes, seconds: float = 1.0) -> float:
@@ -564,6 +572,31 @@ def config12_verify_pipeline(seconds: float):
           "tx/s", None)
 
 
+def config13_readpath_cache(seconds: float):
+    """Block-anchored hot-state read cache (ISSUE 9 acceptance):
+    Zipfian wallet readers + miner polling against the in-process node,
+    the SAME deterministic schedule replayed bypassed and cached while
+    blocks land at a fixed cadence (every window re-pays invalidation).
+    The scenario's built-in differential — cached vs recomputed bodies
+    byte-identical at every stage, including across a forced
+    ``remove_blocks`` reorg — must hold or the run refuses to emit."""
+    import asyncio
+
+    from upow_tpu.loadgen.readpath import ReadpathSpec, run_readpath
+
+    r = asyncio.run(run_readpath(ReadpathSpec()))
+    assert r["differential"]["ok"], \
+        "readpath differential diverged: cached body != recomputed body"
+    _emit("readpath_bypass_p99", r["bypass"]["p99_ms"], "ms", None,
+          direction="lower")
+    _emit("readpath_cached_p99", r["cached"]["p99_ms"], "ms", None,
+          direction="lower")
+    _emit("readpath_speedup_p99", r["speedup_p99"], "x", None,
+          direction="higher")
+    _emit("readpath_hit_ratio", r["cached_pass"]["hit_ratio"], "ratio",
+          None, direction="higher")
+
+
 def config9_sync(seconds: float):
     """End-to-end chain sync over real localhost HTTP: node B downloads
     node A's chain in pages (prefetch pipeline, page-level signature
@@ -703,6 +736,7 @@ def main() -> int:
         "10": lambda: config10_coalesced_intake(args.seconds),
         "11": lambda: config11_perf_observatory(args.seconds),
         "12": lambda: config12_verify_pipeline(args.seconds),
+        "13": lambda: config13_readpath_cache(args.seconds),
     }
     needs_device = {"2", "3", "5", "7"}
     failed = []

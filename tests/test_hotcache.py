@@ -1,0 +1,331 @@
+"""Hot-state read cache: unit + node-integration coverage.
+
+Unit layer drives :class:`upow_tpu.state.hotcache.HotStateCache`
+directly (generation bumps, LRU byte caps, singleflight).  Integration
+layer boots real nodes (test_node's cluster harness) and interrogates
+the wired cache through the HTTP plane: hit accounting, block-accept
+and reorg invalidation with byte-identical responses, the multi-worker
+foreign-writer revalidation path, the one-encode WS broadcast, the
+hardened pagination params, and /debug/cache.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from upow_tpu.config import CacheConfig
+from upow_tpu.state.hotcache import HotStateCache
+
+from test_node import Cluster, mine_via_api, run_cluster  # noqa: F401
+from test_node import easy_difficulty, keys  # noqa: F401 (fixtures)
+
+BYPASS = {"X-Upow-Cache-Bypass": "1"}
+
+
+def _cache(**kw) -> HotStateCache:
+    kw.setdefault("revalidate_interval", -1.0)  # unit tests: sole writer
+    return HotStateCache(state=None, config=CacheConfig(**kw))
+
+
+def _producer(body=b'{"ok": true}'):
+    calls = {"n": 0}
+
+    async def produce() -> bytes:
+        calls["n"] += 1
+        return body
+
+    return produce, calls
+
+
+# ----------------------------------------------------------------- unit ----
+
+def test_bump_invalidates_exactly():
+    async def main():
+        cache = _cache()
+        produce, calls = _producer()
+        assert await cache.get_bytes("supply", (), produce) == b'{"ok": true}'
+        assert await cache.get_bytes("supply", (), produce) == b'{"ok": true}'
+        assert (calls["n"], cache.hits, cache.misses) == (1, 1, 1)
+
+        cache.bump("block")
+        assert await cache.get_bytes("supply", (), produce) == b'{"ok": true}'
+        assert (calls["n"], cache.hits, cache.misses) == (2, 1, 2)
+        # a second read at the new generation hits again
+        await cache.get_bytes("supply", (), produce)
+        assert (calls["n"], cache.hits) == (2, 2)
+        assert cache.stats()["bumps"] == 1
+
+    asyncio.run(main())
+
+
+def test_lru_byte_cap_evicts_oldest():
+    async def main():
+        cache = _cache(class_caps="blocks=100")
+        body = b"x" * 60
+
+        async def produce() -> bytes:
+            return body
+
+        await cache.get_bytes("blocks", ("a",), produce)
+        await cache.get_bytes("blocks", ("b",), produce)  # 120 > 100
+        stats = cache.stats()
+        assert stats["evictions"] == 1
+        assert stats["classes"]["blocks"]["entries"] == 1
+        assert stats["classes"]["blocks"]["bytes"] == 60
+        # the survivor is the newest key: "a" misses, "b" hits
+        await cache.get_bytes("blocks", ("b",), produce)
+        assert cache.hits == 1
+
+    asyncio.run(main())
+
+
+def test_oversized_entry_never_stored():
+    async def main():
+        cache = _cache(max_entry_bytes=32)
+        produce, calls = _producer(b"y" * 64)
+        await cache.get_bytes("blocks", ("big",), produce)
+        await cache.get_bytes("blocks", ("big",), produce)
+        assert calls["n"] == 2  # recomputed: a giant page must not
+        assert cache.stats()["classes"]["blocks"]["bytes"] == 0  # flush LRU
+
+    asyncio.run(main())
+
+
+def test_singleflight_coalesces_32_concurrent_misses():
+    async def main():
+        cache = _cache()
+        gate = asyncio.Event()
+        calls = {"n": 0}
+
+        async def produce() -> bytes:
+            calls["n"] += 1
+            await gate.wait()
+            return b'{"slow": 1}'
+
+        tasks = [asyncio.ensure_future(
+            cache.get_bytes("address", ("hot",), produce))
+            for _ in range(32)]
+        await asyncio.sleep(0)  # all 32 reach the flight table
+        gate.set()
+        bodies = await asyncio.gather(*tasks)
+        assert calls["n"] == 1
+        assert set(bodies) == {b'{"slow": 1}'}
+        assert cache.singleflight_coalesced == 31
+        assert cache.misses == 32
+
+    asyncio.run(main())
+
+
+def test_ws_broadcast_encodes_once(monkeypatch):
+    from upow_tpu.ws import hub as hub_mod
+
+    async def main():
+        hub = hub_mod.WsHub()
+
+        class Sink:
+            def __init__(self):
+                self.frames = []
+
+            async def send_str(self, payload):
+                self.frames.append(payload)
+
+        sinks = [Sink(), Sink()]
+        for sink in sinks:
+            hub.connect_local(sink, channels=("block",))
+
+        real = hub_mod._encode
+        counts = {"n": 0}
+
+        def counting(obj, *a, **kw):
+            counts["n"] += 1
+            return real(obj, *a, **kw)
+
+        monkeypatch.setattr(hub_mod, "_encode", counting)
+        sent = await hub.broadcast_to_channel(
+            "block", {"type": "new_block", "data": {"id": 7}})
+        assert sent == 2
+        for _ in range(100):  # writers drain asynchronously
+            if all(s.frames for s in sinks):
+                break
+            await asyncio.sleep(0.01)
+        assert counts["n"] == 1  # ONE encode for two subscribers
+        assert sinks[0].frames == sinks[1].frames
+        assert json.loads(sinks[0].frames[0])["data"] == {"id": 7}
+        hub.close()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------- integration ----
+
+async def _get(client, path, params=None, bypass=False):
+    resp = await client.get(path, params=params or {},
+                            headers=BYPASS if bypass else {})
+    return resp.status, await resp.read()
+
+
+def test_node_cache_hit_block_invalidation_and_bypass(tmp_path, keys):
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        assert (await mine_via_api(client, keys["addr"]))["ok"]
+
+        s, body1 = await _get(client, "/get_supply_info")
+        hits0 = node.hotcache.hits
+        s2, body2 = await _get(client, "/get_supply_info")
+        assert s == s2 == 200 and body1 == body2
+        assert node.hotcache.hits == hits0 + 1
+
+        # bypass header: computed fresh, still byte-identical, no hit
+        hits1 = node.hotcache.hits
+        s3, body3 = await _get(client, "/get_supply_info", bypass=True)
+        assert s3 == 200 and body3 == body1
+        assert node.hotcache.hits == hits1
+
+        # block accept invalidates: next read recomputes a NEW body
+        assert (await mine_via_api(client, keys["addr"]))["ok"]
+        misses0 = node.hotcache.misses
+        s4, body4 = await _get(client, "/get_supply_info")
+        assert s4 == 200 and body4 != body1
+        assert node.hotcache.misses == misses0 + 1
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_reorg_differential_byte_identical(tmp_path, keys):
+    """Cached and bypassed bodies must match at every stage of
+    accept -> forced reorg -> re-accept (the sync path calls
+    ``remove_blocks`` directly on state, exercising the storage-level
+    invalidation hook, not the manager's)."""
+    probes = [
+        ("/get_supply_info", {}),
+        ("/get_address_info", {"address": "<addr>", "show_pending": "true",
+                               "verify": "true"}),
+        ("/get_blocks_details", {"offset": "0", "limit": "10"}),
+        ("/get_pending_transactions", {}),
+    ]
+
+    async def check_stage(client, addr, stage):
+        bodies = {}
+        for path, params in probes:
+            params = {k: (addr if v == "<addr>" else v)
+                      for k, v in params.items()}
+            s1, cached1 = await _get(client, path, params)
+            s2, cached2 = await _get(client, path, params)
+            s3, fresh = await _get(client, path, params, bypass=True)
+            assert s1 == s2 == s3 == 200, (stage, path)
+            assert cached1 == cached2 == fresh, (stage, path)
+            bodies[path] = cached1
+        return bodies
+
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        addr = keys["addr"]
+        for _ in range(2):
+            assert (await mine_via_api(client, addr))["ok"]
+        before = await check_stage(client, addr, "accepted")
+
+        last = await node.state.get_last_block()
+        await node.state.remove_blocks(last["id"])  # forced reorg
+        after_reorg = await check_stage(client, addr, "post_reorg")
+        assert after_reorg["/get_supply_info"] != \
+            before["/get_supply_info"]  # cache really dropped the tip
+
+        assert (await mine_via_api(client, addr))["ok"]
+        await check_stage(client, addr, "re_accepted")
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_multiworker_foreign_write_forces_miss(tmp_path, keys):
+    """revalidate_interval=0: every read re-anchors against the shared
+    database, so a journal write this process never saw (another
+    worker) bumps the generation and the stale entry misses."""
+
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        for _ in range(2):
+            assert (await mine_via_api(client, keys["addr"]))["ok"]
+        node.hotcache.config.revalidate_interval = 0.0
+
+        s, body1 = await _get(client, "/get_pending_transactions")
+        hits0, misses0 = node.hotcache.hits, node.hotcache.misses
+        await _get(client, "/get_pending_transactions")
+        assert node.hotcache.hits == hits0 + 1
+
+        # the "other worker": a journal insert straight into state,
+        # no node intake, no local bump
+        from upow_tpu.wallet.builders import WalletBuilder
+
+        tx = await WalletBuilder(node.state).create_transaction(
+            keys["d"], keys["addr2"], "1.0")
+        await node.state.add_pending_transaction(tx)
+
+        foreign0 = node.hotcache.foreign_bumps
+        misses1 = node.hotcache.misses
+        s2, body2 = await _get(client, "/get_pending_transactions")
+        assert s == s2 == 200
+        assert node.hotcache.foreign_bumps == foreign0 + 1
+        assert node.hotcache.misses == misses1 + 1
+        assert body2 != body1  # the new pending tx is visible
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_pagination_hardening(tmp_path, keys):
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        assert (await mine_via_api(client, keys["addr"]))["ok"]
+
+        # non-integers: clean 400 envelope, not a 500
+        for path, params in (
+                ("/get_blocks", {"limit": "abc"}),
+                ("/get_blocks", {"offset": "1e3"}),
+                ("/get_blocks_details", {"offset": "abc"}),
+                ("/get_address_transactions",
+                 {"address": keys["addr"], "page": "zz"}),
+                ("/get_address_transactions",
+                 {"address": keys["addr"], "limit": "0x10"}),
+        ):
+            status, body = await _get(client, path, params)
+            assert status == 400, (path, params)
+            assert json.loads(body)["ok"] is False
+
+        # negatives and oversized values clamp instead of erroring
+        for path, params in (
+                ("/get_blocks", {"offset": "-5", "limit": "99999999"}),
+                ("/get_blocks_details", {"offset": str(2 ** 80)}),
+                ("/get_address_transactions",
+                 {"address": keys["addr"], "page": "-2",
+                  "limit": str(2 ** 70)}),
+        ):
+            status, body = await _get(client, path, params)
+            assert status == 200, (path, params)
+            assert json.loads(body)["ok"] is True
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_debug_cache_endpoint(tmp_path, keys):
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        assert (await mine_via_api(client, keys["addr"]))["ok"]
+        await _get(client, "/get_supply_info")
+        await _get(client, "/get_supply_info")
+
+        status, body = await _get(client, "/debug/cache")
+        assert status == 200
+        stats = json.loads(body)["result"]
+        assert stats["enabled"] is True
+        assert stats["hits"] >= 1 and stats["misses"] >= 1
+        assert stats["generation"] >= 1
+        assert "supply" in stats["classes"]
+        assert stats["classes"]["supply"]["bytes"] > 0
+
+        # /metrics exports the same counters in prom exposition form
+        resp = await client.get("/metrics")
+        text = await resp.text()
+        assert "upow_hotcache_hits_total" in text
+        assert "upow_hotcache_generation" in text
+
+    run_cluster(tmp_path, scenario)

@@ -63,6 +63,53 @@ def test_loadgen_against_node():
     assert ws["total_connections"] == 0
 
 
+def _tiny_readpath():
+    """CI-sized readpath: still covers all four differential stages
+    and one invalidation window per pass, in a couple of seconds."""
+    from upow_tpu.loadgen.readpath import ReadpathSpec
+
+    return ReadpathSpec(n_wallets=4, n_requests=120, block_every=60,
+                        n_fan=4, n_per=6, history_limit=5, blocks_limit=5)
+
+
+def test_readpath_differential_and_refusal(monkeypatch):
+    """The readpath scenario's built-in differential holds across
+    accept -> forced reorg -> re-accept; and when a probe DOES diverge
+    the run refuses to report latencies (headline zeroed, the
+    gate-tripping convention)."""
+    from upow_tpu.loadgen import readpath as rp
+
+    result = asyncio.run(rp.run_readpath(_tiny_readpath()))
+    assert result["differential"]["ok"]
+    assert result["differential"]["checks"] == 4 * 13  # stages x probes
+    assert {s["stage"] for s in result["differential"]["stages"]} == \
+        {"initial", "post_block", "post_reorg", "post_reaccept"}
+    assert result["speedup_p99"] > 0
+    assert result["bypass"]["requests"] == result["cached"]["requests"]
+    assert result["cached_pass"]["hit_ratio"] > 0.5
+
+    # forced divergence: corrupt what the cache hands back so the
+    # second cached fetch of every probe disagrees with the bypass
+    orig = rp._fetch
+    flip = {"n": 0}
+
+    async def corrupting(client, path, params, bypass):
+        status, body, dt = await orig(client, path, params, bypass)
+        if not bypass:
+            flip["n"] += 1
+            if flip["n"] % 2 == 0:
+                body = body + b" "
+        return status, body, dt
+
+    monkeypatch.setattr(rp, "_fetch", corrupting)
+    poisoned = asyncio.run(rp.run_readpath(_tiny_readpath()))
+    assert poisoned["differential"]["ok"] is False
+    assert poisoned["speedup_p99"] == 0.0
+    assert "bypass" not in poisoned and "cached" not in poisoned
+    stage0 = poisoned["differential"]["stages"][0]
+    assert stage0["mismatches"]  # the evidence rides in the artifact
+
+
 def test_observatory_artifact_and_gate(tmp_path):
     """Acceptance path: one run_observatory() artifact carries SLO +
     kernels + provenance, self-gates clean, and an injected synthetic
@@ -72,12 +119,23 @@ def test_observatory_artifact_and_gate(tmp_path):
                                               run_observatory,
                                               write_artifact)
 
-    artifact = run_observatory(PopulationSpec.smoke(), bench_seconds=0.05)
+    artifact = run_observatory(PopulationSpec.smoke(), bench_seconds=0.05,
+                               readpath_spec=_tiny_readpath())
     assert artifact["kind"] == "perf_observatory"
     assert artifact["provenance"]["backend"] == "node-inprocess"
     assert "arm_failure_reason" in artifact["provenance"]
     assert artifact["kernels"]["search_python_loop"]["value"] > 0
     assert artifact["slo"]["endpoints"]["push_tx"]["req_s"] > 0
+
+    # readpath rode along: differential green, headline mirrored into
+    # kernels with explicit gate directions
+    assert artifact["readpath"]["differential"]["ok"]
+    speedup = artifact["kernels"]["readpath_speedup_p99"]
+    assert speedup["direction"] == "higher" and speedup["value"] > 0
+    assert speedup["differential_ok"] is True
+    assert artifact["kernels"]["readpath_cached_p99_ms"]["direction"] \
+        == "lower"
+    assert 0 < artifact["kernels"]["readpath_hit_ratio"]["value"] <= 1
 
     out = tmp_path / "observatory.json"
     write_artifact(artifact, str(out))

@@ -215,6 +215,11 @@ def test_partition_heal_scenario():
     assert core["converged_after_heal"]
     assert core["final_height"] == 7
     assert core["losers_reorged"]
+    # ISSUE 9 tie-in: the losers' hot-state caches served the stale
+    # partition balance before heal and the winner's bytes after — the
+    # reorg hook, not the revalidation backstop, invalidated them
+    # (swarm_config disables foreign revalidation outright)
+    assert core["loser_caches_invalidated"]
     assert core["reorgs_share_heal_trace"]
     assert core["trace_spans_nodes"]
     assert core["breakers_flipped_during_partition"]

@@ -149,6 +149,42 @@ class MempoolConfig:
 
 
 @dataclass
+class CacheConfig:
+    """Generation-anchored hot-state read cache (state/hotcache.py,
+    docs/CACHING.md).  Operational only: the cache serves byte-identical
+    responses, so nodes with different cache settings stay bit-identical
+    on the wire.  All overridable as ``UPOW_CACHE_<FIELD>``."""
+
+    enabled: bool = True
+    class_cap_bytes: int = 8 * 1024 * 1024  # default LRU byte cap per
+                                    # entry class (address/blocks/tx/...)
+    class_caps: str = ""            # per-class overrides, e.g.
+                                    # "address=16777216,blocks=4194304"
+    max_entry_bytes: int = 1 * 1024 * 1024  # bodies above this are
+                                    # served but never stored (one giant
+                                    # page must not flush a whole class)
+    revalidate_interval: float = 0.25  # seconds between re-anchoring the
+                                    # generation against the shared DB
+                                    # (tip hash + journal stamp) to catch
+                                    # OTHER workers' writes; 0 = every
+                                    # read, negative = never (sole-writer
+                                    # process)
+
+    def parsed_class_caps(self) -> dict:
+        caps = {}
+        for part in self.class_caps.split(","):
+            name, _, raw = part.strip().partition("=")
+            if name and raw:
+                try:
+                    caps[name] = int(raw)
+                except ValueError:
+                    raise ValueError(
+                        f"cache.class_caps entry {part!r}: cap must be an"
+                        f" integer byte count") from None
+        return caps
+
+
+@dataclass
 class NodeConfig:
     host: str = "0.0.0.0"
     port: int = 3006                # reference run_node.py port
@@ -264,6 +300,7 @@ class Config:
     log: LogConfig = field(default_factory=LogConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    cache: CacheConfig = field(default_factory=CacheConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     profile: ProfilingConfig = field(default_factory=ProfilingConfig)
 
@@ -306,7 +343,7 @@ def _merge_dict(cfg: Config, data: dict) -> Config:
 
 def _merge_env(cfg: Config) -> Config:
     for section in ("device", "node", "ws", "miner", "log", "resilience",
-                    "mempool", "telemetry", "profile"):
+                    "mempool", "cache", "telemetry", "profile"):
         sub = getattr(cfg, section)
         for f in dataclasses.fields(sub):
             env = f"UPOW_{section.upper()}_{f.name.upper()}"

@@ -45,13 +45,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     from .observatory import append_progress, run_observatory, write_artifact
+    from .readpath import ReadpathSpec
 
     spec = PopulationSpec.smoke() if args.smoke else PopulationSpec()
+    rp_spec = ReadpathSpec.smoke() if args.smoke else ReadpathSpec()
     if args.seed is not None:
         spec.seed = args.seed
+        rp_spec.seed = args.seed
 
     artifact = run_observatory(spec, bench_seconds=args.bench_seconds,
-                               device=args.device, cost=args.cost)
+                               device=args.device, cost=args.cost,
+                               readpath_spec=rp_spec)
     write_artifact(artifact, args.out)
     if args.progress:
         append_progress(artifact, args.progress)

@@ -9,6 +9,10 @@ structured JSON artifact:
   plus the node's own server-side histogram estimates.
 * ``kernels`` — host kernel rates (python / native search + verify)
   and, when armed, the freshest persisted TPU capture.
+* ``readpath`` — the hot-state cache scenario (:mod:`.readpath`):
+  cached vs bypassed p99 under block cadence, with its byte-identity
+  differential; headline metrics are mirrored into ``kernels`` with
+  explicit gate directions.
 * ``provenance`` — what actually ran: ``backend``, ``platform``,
   ``attempted_backend``, ``arm_failure_reason``.  BENCH_r02–r05 all
   silently degraded to a scrubbed-env CPU child; this block is the
@@ -137,7 +141,8 @@ def run_observatory(spec: Optional[PopulationSpec] = None,
                     bench_seconds: float = 0.4,
                     device: bool = False,
                     cost: bool = False,
-                    probe_timeout: float = 90.0) -> dict:
+                    probe_timeout: float = 90.0,
+                    readpath_spec=None) -> dict:
     """Run loadgen + kernel benches; return the merged artifact."""
     from .harness import run_against_node
 
@@ -149,6 +154,34 @@ def run_observatory(spec: Optional[PopulationSpec] = None,
 
     load = asyncio.run(run_against_node(spec))
     kernels = kernel_bench(bench_seconds)
+
+    readpath = None
+    try:
+        from .readpath import ReadpathSpec, run_readpath
+
+        readpath = asyncio.run(run_readpath(readpath_spec
+                                            or ReadpathSpec()))
+    except Exception as e:
+        log.warning("readpath scenario skipped: %s", e)
+    if readpath is not None:
+        diff_ok = readpath["differential"]["ok"]
+        # divergence zeroes the headline (run_readpath already refused
+        # to report latencies); the explicit direction keeps gate.py
+        # from latency-token-inferring "lower" off the _p99 suffix
+        kernels["readpath_speedup_p99"] = {
+            "value": readpath["speedup_p99"] or 0.0, "unit": "x",
+            "direction": "higher", "differential_ok": diff_ok,
+            "differential_checks": readpath["differential"]["checks"]}
+        if diff_ok:
+            kernels["readpath_bypass_p99_ms"] = {
+                "value": readpath["bypass"]["p99_ms"], "unit": "ms",
+                "direction": "lower"}
+            kernels["readpath_cached_p99_ms"] = {
+                "value": readpath["cached"]["p99_ms"], "unit": "ms",
+                "direction": "lower"}
+            kernels["readpath_hit_ratio"] = {
+                "value": readpath["cached_pass"]["hit_ratio"],
+                "unit": "ratio", "direction": "higher"}
 
     if cost:
         try:
@@ -187,6 +220,8 @@ def run_observatory(spec: Optional[PopulationSpec] = None,
         "kernels": kernels,
         "provenance": provenance,
     }
+    if readpath is not None:
+        artifact["readpath"] = readpath
     return artifact
 
 

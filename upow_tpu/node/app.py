@@ -73,6 +73,20 @@ def _int_q(q, name: str, default: int, cap: int = None) -> int:
 # the one banned address (main.py:426-430)
 _BANNED_ADDRESSES = {"DgQKikeDqS2Fzue23KuA36L4eJSFh649zA9jJ6zwbzUMp"}
 
+# any value in this header skips the hot-state cache for one request
+# (the response is computed fresh and NOT stored) — the loadgen
+# differential and operators diagnosing a suspected stale read use it;
+# correctness never depends on it because entries are generation-keyed
+_CACHE_BYPASS_HEADER = "X-Upow-Cache-Bypass"
+
+# every get_address_info query flag that shapes the response — the
+# cache key must carry all of them (a hit is only valid for the exact
+# flag combination it was computed under)
+_ADDRESS_INFO_FLAGS = (
+    "show_pending", "verify", "stake_outputs", "delegate_spent_votes",
+    "delegate_unspent_votes", "inode_registration_outputs",
+    "validator_unspent_votes", "validator_spent_votes", "address_state")
+
 
 def _fmt_amount(smallest_units: int) -> str:
     return "{:f}".format(Decimal(smallest_units) / SMALLEST)
@@ -162,6 +176,28 @@ class Node:
         self.mining_cache = MiningInfoCache()
         self.state.reinject_reorg_txs = bool(mcfg.enabled
                                              and mcfg.reinject_on_reorg)
+        # generation-anchored hot-state read cache (state/hotcache.py,
+        # docs/CACHING.md): read endpoints serve stored response BYTES
+        # keyed by a generation the hooks below advance after every
+        # committed write, so a hit never reflects a stale tip
+        from ..state.hotcache import HotStateCache
+
+        self.hotcache = HotStateCache(self.state, self.config.cache)
+        if self.config.cache.enabled:
+            self.manager.on_state_committed = self.hotcache.bump
+            self.state.on_blocks_removed = \
+                lambda _from_id: self.hotcache.bump("reorg")
+            # chain the mempool hook: GC evictions and mined-tx removals
+            # change the pending journal, which read responses (pending
+            # tx lists, show_pending balances) depend on
+            pool_remove = self.manager.on_pending_removed
+
+            def _pending_removed(hashes, _base=pool_remove):
+                if _base is not None:
+                    _base(hashes)
+                self.hotcache.bump("pending_removed")
+
+            self.manager.on_pending_removed = _pending_removed
         # push_tx dedup: config-sized TTL set — the reference's 100-entry
         # deque cycles out in milliseconds at target intake rates,
         # reopening the duplicate-propagation window it exists to close
@@ -470,8 +506,14 @@ class Node:
         micro-batch and shares its signature dispatch), else the serial
         reference path."""
         if self.config.mempool.enabled:
-            return await self.intake.submit(tx, sender)
-        return await self._verify_and_push_tx(tx, sender)
+            result = await self.intake.submit(tx, sender)
+        else:
+            result = await self._verify_and_push_tx(tx, sender)
+        if result.get("ok"):
+            # the pending journal gained a row — cached pending-tx
+            # lists and show_pending address views are now stale
+            self.hotcache.bump("pending_added")
+        return result
 
     async def _verify_and_push_tx(self, tx: Tx,
                                   sender: Optional[str]) -> dict:
@@ -561,6 +603,29 @@ class Node:
             self.mining_cache.put(key, result)
         return result
 
+    # ------------------------------------------------------- read cache ---
+    async def _cached(self, request: web.Request, entry_class: str,
+                      key: tuple, build, dumps=json.dumps) -> web.Response:
+        """Serve a read endpoint through the hot-state cache.
+
+        ``build()`` produces the JSON-clean payload; what gets cached is
+        the ENCODED body (``dumps(payload).encode("utf-8")`` — exactly
+        the bytes ``web.json_response`` would have sent), so a hit skips
+        both SQL and encoding and is byte-identical to the uncached
+        response by construction.  Disabled cache or a
+        ``X-Upow-Cache-Bypass`` header fall through to the plain path
+        without touching the store."""
+        if not self.hotcache.enabled or \
+                _CACHE_BYPASS_HEADER in request.headers:
+            return web.json_response(await build(), dumps=dumps)
+
+        async def produce() -> bytes:
+            return dumps(await build()).encode("utf-8")
+
+        body = await self.hotcache.get_bytes(entry_class, key, produce)
+        return web.Response(body=body, content_type="application/json",
+                            charset="utf-8")
+
     # --------------------------------------------------------- handlers ---
     async def h_root(self, request: web.Request) -> web.Response:
         """Health probe (reference main.py:266-275) + additive timing
@@ -620,6 +685,35 @@ class Node:
                   "Signature checks answered from the verdict cache")
         e.counter("sig_cache_misses", sig["misses"],
                   "Signature checks that required verification")
+        if self.hotcache.enabled:
+            cs = self.hotcache.stats()
+            e.counter("hotcache_hits", cs["hits"],
+                      "Read responses served from the hot-state cache")
+            e.counter("hotcache_misses", cs["misses"],
+                      "Read responses rebuilt from storage")
+            e.counter("hotcache_evictions", cs["evictions"],
+                      "Entries evicted by per-class LRU byte caps")
+            e.counter("hotcache_singleflight_coalesced",
+                      cs["singleflight_coalesced"],
+                      "Concurrent identical misses that shared one"
+                      " storage trip")
+            e.counter("hotcache_generation_bumps", cs["bumps"],
+                      "Local generation advances (block accept, reorg,"
+                      " pending-journal change)")
+            e.counter("hotcache_foreign_bumps", cs["foreign_bumps"],
+                      "Generation advances forced by another worker's"
+                      " write (journal-stamp revalidation)")
+            e.gauge("hotcache_generation", cs["generation"],
+                    "Current read-cache generation epoch")
+            e.gauge("hotcache_generation_age_seconds",
+                    cs["generation_age_seconds"],
+                    "Seconds since the generation last advanced")
+            e.gauge("hotcache_entries",
+                    sum(c["entries"] for c in cs["classes"].values()),
+                    "Entries across all hot-state cache classes")
+            e.gauge("hotcache_bytes",
+                    sum(c["bytes"] for c in cs["classes"].values()),
+                    "Encoded response bytes held by the hot-state cache")
         if self.ws_hub is not None:
             ws = self.ws_hub.get_stats()
             e.gauge("ws_connections", ws["total_connections"],
@@ -689,6 +783,25 @@ class Node:
                 status=400)
         return max(0, min(value, cls._DEBUG_LIMIT_CAP)), None
 
+    @staticmethod
+    def _page_param(q, name: str, default: int, cap: int):
+        """Public pagination param, hardened the same way as
+        ``_debug_limit``: (value, None) or (None, 400 response).
+        Negative values clamp to 0 and oversized ones to ``cap`` — an
+        unclamped limit on the uncached SQL path is an easy self-DoS —
+        and non-integers answer a clean 400 instead of the generic
+        ``_int_q`` 422, naming the offending parameter."""
+        raw = q.get(name)
+        if raw is None or raw == "":
+            return default, None
+        try:
+            value = int(raw)
+        except ValueError:
+            return None, web.json_response(
+                {"ok": False, "error": f"{name} must be an integer"},
+                status=400)
+        return max(0, min(value, cap)), None
+
     async def h_debug_traces(self, request: web.Request) -> web.Response:
         """Completed trace trees: recency ring + slowest top-N
         (telemetry/tracing.py TraceBuffer).  ``limit`` bounds both
@@ -715,6 +828,14 @@ class Node:
             "ok": True,
             "result": telemetry.events.snapshot(limit=limit or None,
                                                 kind=kind)})
+
+    async def h_debug_cache(self, request: web.Request) -> web.Response:
+        """Hot-state read cache introspection: per-class entry counts
+        and byte usage, hit/miss/eviction/coalesce counters, and the
+        current generation + its age — everything an operator needs to
+        size the ``UPOW_CACHE_*`` caps or confirm invalidations fire."""
+        return web.json_response(
+            {"ok": True, "result": self.hotcache.stats()})
 
     async def h_debug_breakers(self, request: web.Request) -> web.Response:
         """Per-peer circuit state + EWMA health score, exactly what
@@ -919,24 +1040,31 @@ class Node:
         inode = q.get("inode")
         offset = _int_q(q, "offset", 0)
         limit = _int_q(q, "limit", 100, cap=1000)
-        rows = await self.state.get_ballots(
-            "inodes_ballot", inode, offset=offset, limit=limit)
-        by_validator: dict = {}
-        stakes: dict = {}  # one stake computation per distinct validator
-        for row in rows:
-            ent = by_validator.setdefault(row["voter"], {
-                "validator": row["voter"], "vote": []})
-            ent["vote"].append({
-                "wallet": row["recipient"],
-                "vote_count": str(row["vote"]),
-                "tx_hash": row["tx_hash"],
-                "index": row["index"],
-            })
-            if row["voter"] not in stakes:
-                stakes[row["voter"]] = str(await self.state.get_validators_stake(
-                    row["voter"], check_pending_txs=True))
-            ent["totalStake"] = stakes[row["voter"]]
-        return web.json_response(list(by_validator.values()))
+
+        async def build():
+            rows = await self.state.get_ballots(
+                "inodes_ballot", inode, offset=offset, limit=limit)
+            by_validator: dict = {}
+            stakes: dict = {}  # one stake computation per validator
+            for row in rows:
+                ent = by_validator.setdefault(row["voter"], {
+                    "validator": row["voter"], "vote": []})
+                ent["vote"].append({
+                    "wallet": row["recipient"],
+                    "vote_count": str(row["vote"]),
+                    "tx_hash": row["tx_hash"],
+                    "index": row["index"],
+                })
+                if row["voter"] not in stakes:
+                    stakes[row["voter"]] = str(
+                        await self.state.get_validators_stake(
+                            row["voter"], check_pending_txs=True))
+                ent["totalStake"] = stakes[row["voter"]]
+            return list(by_validator.values())
+
+        return await self._cached(request, "governance",
+                                  ("validators", inode, offset, limit),
+                                  build)
 
     async def h_get_delegates_info(self, request: web.Request) -> web.Response:
         """Validator ballot grouped by voting delegate, batch stake
@@ -945,23 +1073,31 @@ class Node:
         validator = q.get("validator")
         offset = _int_q(q, "offset", 0)
         limit = _int_q(q, "limit", 100, cap=1000)
-        rows = await self.state.get_ballots(
-            "validators_ballot", validator, offset=offset, limit=limit)
-        stakes = await self.state.get_multiple_address_stakes(
-            {row["voter"] for row in rows if row["voter"]},
-            check_pending_txs=True)
-        by_delegate: dict = {}
-        for row in rows:
-            ent = by_delegate.setdefault(row["voter"], {
-                "delegate": row["voter"], "vote": [], "totalStake": "0"})
-            ent["vote"].append({
-                "wallet": row["recipient"],
-                "vote_count": str(row["vote"]),
-                "tx_hash": row["tx_hash"],
-                "index": row["index"],
-            })
-            ent["totalStake"] = str(stakes.get(row["voter"], Decimal(0)))
-        return web.json_response(list(by_delegate.values()))
+
+        async def build():
+            rows = await self.state.get_ballots(
+                "validators_ballot", validator, offset=offset, limit=limit)
+            stakes = await self.state.get_multiple_address_stakes(
+                {row["voter"] for row in rows if row["voter"]},
+                check_pending_txs=True)
+            by_delegate: dict = {}
+            for row in rows:
+                ent = by_delegate.setdefault(row["voter"], {
+                    "delegate": row["voter"], "vote": [],
+                    "totalStake": "0"})
+                ent["vote"].append({
+                    "wallet": row["recipient"],
+                    "vote_count": str(row["vote"]),
+                    "tx_hash": row["tx_hash"],
+                    "index": row["index"],
+                })
+                ent["totalStake"] = str(stakes.get(row["voter"],
+                                                   Decimal(0)))
+            return list(by_delegate.values())
+
+        return await self._cached(request, "governance",
+                                  ("delegates", validator, offset, limit),
+                                  build)
 
     async def h_get_address_info(self, request: web.Request) -> web.Response:
         q = request.rel_url.query
@@ -973,95 +1109,110 @@ class Node:
         def flag(name):
             return q.get(name, "false").lower() in ("1", "true", "yes")
 
-        outputs = await self.state.get_spendable_outputs(address)
-        stake = await self.state.get_address_stake(address)
-        balance = sum(o.amount for o in outputs)
+        async def build():
+            outputs = await self.state.get_spendable_outputs(address)
+            stake = await self.state.get_address_stake(address)
+            balance = sum(o.amount for o in outputs)
 
-        def out_list(rows):
-            return [{"amount": _fmt_amount(r["amount"]),
-                     "tx_hash": r["tx_hash"], "index": r["index"]} for r in rows]
+            def out_list(rows):
+                return [{"amount": _fmt_amount(r["amount"]),
+                         "tx_hash": r["tx_hash"], "index": r["index"]}
+                        for r in rows]
 
-        result = {
-            "balance": _fmt_amount(balance),
-            "stake": str(stake),
-            "spendable_outputs": [
-                {"amount": _fmt_amount(o.amount), "tx_hash": o.tx_hash,
-                 "index": o.index} for o in outputs],
-            "pending_transactions": None,
-            "pending_spent_outputs": None,
-            "stake_outputs": None,
-            "delegate_spent_votes": None,
-            "delegate_unspent_votes": None,
-            "inode_registration_outputs": None,
-            "validator_unspent_votes": None,
-            "validator_spent_votes": None,
-            "is_inode": None,
-            "is_inode_active": None,
-            "is_validator": None,
-        }
-        def vote_list(rows):
-            return [{"amount": str(r["vote"]), "tx_hash": r["tx_hash"],
-                     "index": r["index"]} for r in rows]
+            result = {
+                "balance": _fmt_amount(balance),
+                "stake": str(stake),
+                "spendable_outputs": [
+                    {"amount": _fmt_amount(o.amount), "tx_hash": o.tx_hash,
+                     "index": o.index} for o in outputs],
+                "pending_transactions": None,
+                "pending_spent_outputs": None,
+                "stake_outputs": None,
+                "delegate_spent_votes": None,
+                "delegate_unspent_votes": None,
+                "inode_registration_outputs": None,
+                "validator_unspent_votes": None,
+                "validator_spent_votes": None,
+                "is_inode": None,
+                "is_inode_active": None,
+                "is_validator": None,
+            }
 
-        if flag("show_pending"):
-            pending = await self.state.get_address_pending_transactions(address)
-            result["pending_transactions"] = [
-                await self.state.get_nice_transaction(
-                    tx.hash(), address if flag("verify") else None)
-                for tx in pending
-            ]
-            result["pending_spent_outputs"] = [
-                {"tx_hash": h, "index": i}
-                for h, i in await self.state.get_address_pending_spent_outpoints(address)
-            ]
-        if flag("stake_outputs"):
-            result["stake_outputs"] = out_list(
-                await self.state.get_outputs_by_address(
-                    "unspent_outputs", address, is_stake=True))
-        if flag("delegate_spent_votes"):
-            result["delegate_spent_votes"] = vote_list(
-                await self.state.get_delegates_spent_votes(address))
-        if flag("delegate_unspent_votes"):
-            result["delegate_unspent_votes"] = out_list(
-                await self.state.get_outputs_by_address(
-                    "delegates_voting_power", address))
-        if flag("inode_registration_outputs"):
-            result["inode_registration_outputs"] = out_list(
-                await self.state.get_outputs_by_address(
-                    "inode_registration_output", address))
-        if flag("validator_unspent_votes"):
-            result["validator_unspent_votes"] = out_list(
-                await self.state.get_outputs_by_address(
-                    "validators_voting_power", address))
-        if flag("validator_spent_votes"):
-            result["validator_spent_votes"] = vote_list(
-                await self.state.get_validators_spent_votes(address))
-        if flag("address_state"):
-            is_inode = await self.state.is_inode_registered(address)
-            result["is_inode"] = is_inode
-            if is_inode:
-                active = await self.manager.get_active_inodes_cached()
-                result["is_inode_active"] = any(
-                    e.get("wallet") == address for e in active)
-            else:
-                result["is_inode_active"] = False
-            result["is_validator"] = await self.state.is_validator_registered(address)
-        return web.json_response({"ok": True, "result": result})
+            def vote_list(rows):
+                return [{"amount": str(r["vote"]), "tx_hash": r["tx_hash"],
+                         "index": r["index"]} for r in rows]
+
+            if flag("show_pending"):
+                pending = await self.state.get_address_pending_transactions(address)
+                result["pending_transactions"] = [
+                    await self.state.get_nice_transaction(
+                        tx.hash(), address if flag("verify") else None)
+                    for tx in pending
+                ]
+                result["pending_spent_outputs"] = [
+                    {"tx_hash": h, "index": i}
+                    for h, i in await self.state.get_address_pending_spent_outpoints(address)
+                ]
+            if flag("stake_outputs"):
+                result["stake_outputs"] = out_list(
+                    await self.state.get_outputs_by_address(
+                        "unspent_outputs", address, is_stake=True))
+            if flag("delegate_spent_votes"):
+                result["delegate_spent_votes"] = vote_list(
+                    await self.state.get_delegates_spent_votes(address))
+            if flag("delegate_unspent_votes"):
+                result["delegate_unspent_votes"] = out_list(
+                    await self.state.get_outputs_by_address(
+                        "delegates_voting_power", address))
+            if flag("inode_registration_outputs"):
+                result["inode_registration_outputs"] = out_list(
+                    await self.state.get_outputs_by_address(
+                        "inode_registration_output", address))
+            if flag("validator_unspent_votes"):
+                result["validator_unspent_votes"] = out_list(
+                    await self.state.get_outputs_by_address(
+                        "validators_voting_power", address))
+            if flag("validator_spent_votes"):
+                result["validator_spent_votes"] = vote_list(
+                    await self.state.get_validators_spent_votes(address))
+            if flag("address_state"):
+                is_inode = await self.state.is_inode_registered(address)
+                result["is_inode"] = is_inode
+                if is_inode:
+                    active = await self.manager.get_active_inodes_cached()
+                    result["is_inode_active"] = any(
+                        e.get("wallet") == address for e in active)
+                else:
+                    result["is_inode_active"] = False
+                result["is_validator"] = await self.state.is_validator_registered(address)
+            return {"ok": True, "result": result}
+
+        key = (address,) + tuple(flag(n) for n in _ADDRESS_INFO_FLAGS)
+        return await self._cached(request, "address", key, build)
 
     async def h_get_address_transactions(self, request: web.Request) -> web.Response:
         q = request.rel_url.query
         address = q.get("address")
-        page = max(_int_q(q, "page", 1), 1)
-        limit = _int_q(q, "limit", 5, cap=1000)
+        page, err = self._page_param(q, "page", 1, 2 ** 63 - 1)
+        if err is None:
+            limit, err = self._page_param(q, "limit", 5, 1000)
+        if err is not None:
+            return err
+        page = max(page, 1)
         # the PRODUCT can overflow int64 even with both factors clamped
         offset = min((page - 1) * limit, 2 ** 63 - 1)
-        rows = await self.state.get_address_transactions(
-            address, limit=limit, offset=offset)
-        return web.json_response({"ok": True, "result": {
-            "transactions": [
-                await self.state.get_nice_transaction(r["tx_hash"])
-                for r in rows]
-        }})
+
+        async def build():
+            rows = await self.state.get_address_transactions(
+                address, limit=limit, offset=offset)
+            return {"ok": True, "result": {
+                "transactions": [
+                    await self.state.get_nice_transaction(r["tx_hash"])
+                    for r in rows]
+            }}
+
+        return await self._cached(request, "history",
+                                  (address, limit, offset), build)
 
     async def h_add_node(self, request: web.Request) -> web.Response:
         url = request.rel_url.query.get("url", "").strip("/")
@@ -1094,16 +1245,23 @@ class Node:
             {"ok": True, "result": self.peers.recent_nodes()[:100]})
 
     async def h_get_pending_transactions(self, request: web.Request) -> web.Response:
-        txs = await self.state.get_pending_transactions_limit(hex_only=True)
-        return web.json_response({"ok": True, "result": txs})
+        async def build():
+            txs = await self.state.get_pending_transactions_limit(
+                hex_only=True)
+            return {"ok": True, "result": txs}
+
+        return await self._cached(request, "pending", (), build)
 
     async def h_get_transaction(self, request: web.Request) -> web.Response:
         tx_hash = request.rel_url.query.get("tx_hash", "")
-        tx = await self.state.get_nice_transaction(tx_hash)
-        if tx is None:
-            return web.json_response(
-                {"ok": False, "error": "Transaction not found"})
-        return web.json_response({"ok": True, "result": tx})
+
+        async def build():
+            tx = await self.state.get_nice_transaction(tx_hash)
+            if tx is None:
+                return {"ok": False, "error": "Transaction not found"}
+            return {"ok": True, "result": tx}
+
+        return await self._cached(request, "tx", (tx_hash,), build)
 
     async def _block_lookup(self, block: str) -> Optional[dict]:
         if block.isdecimal():
@@ -1119,47 +1277,75 @@ class Node:
         q = request.rel_url.query
         block = q.get("block", "")
         full = q.get("full_transactions", "false").lower() in ("1", "true")
-        info = await self._block_lookup(block)
-        if not info:
-            return web.json_response({"ok": False, "error": "Block not found"})
-        block_hash = info["hash"]
-        return web.json_response({"ok": True, "result": {
-            "block": _json_block(info),
-            "transactions": (
-                await self.state.get_block_transactions(block_hash, hex_only=True)
-                if not full else None),
-            "full_transactions": (
-                await self.state.get_block_nice_transactions(block_hash)
-                if full else None),
-        }})
+
+        async def build():
+            info = await self._block_lookup(block)
+            if not info:
+                return {"ok": False, "error": "Block not found"}
+            block_hash = info["hash"]
+            return {"ok": True, "result": {
+                "block": _json_block(info),
+                "transactions": (
+                    await self.state.get_block_transactions(block_hash,
+                                                            hex_only=True)
+                    if not full else None),
+                "full_transactions": (
+                    await self.state.get_block_nice_transactions(block_hash)
+                    if full else None),
+            }}
+
+        return await self._cached(request, "block", ("block", block, full),
+                                  build)
 
     async def h_get_block_details(self, request: web.Request) -> web.Response:
         block = request.rel_url.query.get("block", "")
-        info = await self._block_lookup(block)
-        if not info:
-            return web.json_response({"ok": False, "error": "Block not found"})
-        # the views helper drops reorg-raced Nones (never embed null)
-        txs = await self.state.get_block_nice_transactions(info["hash"])
-        return web.json_response({"ok": True, "result": {
-            "block": _json_block(info),
-            "transactions": txs,
-        }})
+
+        async def build():
+            info = await self._block_lookup(block)
+            if not info:
+                return {"ok": False, "error": "Block not found"}
+            # the views helper drops reorg-raced Nones (never embed null)
+            txs = await self.state.get_block_nice_transactions(info["hash"])
+            return {"ok": True, "result": {
+                "block": _json_block(info),
+                "transactions": txs,
+            }}
+
+        return await self._cached(request, "block", ("details", block),
+                                  build)
 
     async def h_get_blocks(self, request: web.Request) -> web.Response:
         q = request.rel_url.query
-        offset = _int_q(q, "offset", 0)
-        limit = _int_q(q, "limit", 100, cap=1000)
-        blocks = await self.state.get_blocks(offset, limit,
-                                             size_capped=True)
-        return web.json_response({"ok": True, "result": blocks})
+        offset, err = self._page_param(q, "offset", 0, 2 ** 63 - 1)
+        if err is None:
+            limit, err = self._page_param(q, "limit", 100, 1000)
+        if err is not None:
+            return err
+
+        async def build():
+            blocks = await self.state.get_blocks(offset, limit,
+                                                 size_capped=True)
+            return {"ok": True, "result": blocks}
+
+        return await self._cached(request, "blocks",
+                                  ("blocks", offset, limit), build)
 
     async def h_get_blocks_details(self, request: web.Request) -> web.Response:
         q = request.rel_url.query
-        offset = _int_q(q, "offset", 0)
-        limit = _int_q(q, "limit", 100, cap=1000)
-        blocks = await self.state.get_blocks(offset, limit, tx_details=True,
-                                             size_capped=True)
-        return web.json_response({"ok": True, "result": blocks})
+        offset, err = self._page_param(q, "offset", 0, 2 ** 63 - 1)
+        if err is None:
+            limit, err = self._page_param(q, "limit", 100, 1000)
+        if err is not None:
+            return err
+
+        async def build():
+            blocks = await self.state.get_blocks(offset, limit,
+                                                 tx_details=True,
+                                                 size_capped=True)
+            return {"ok": True, "result": blocks}
+
+        return await self._cached(request, "blocks",
+                                  ("details", offset, limit), build)
 
     async def h_dobby_info(self, request: web.Request) -> web.Response:
         inodes = await self.manager.get_active_inodes_cached()
@@ -1173,13 +1359,16 @@ class Node:
                                  dumps=_json_dumps)
 
     async def h_get_supply_info(self, request: web.Request) -> web.Response:
-        last_block = await self.state.get_last_block()
-        last_id = last_block["id"] if last_block else 0
-        return web.json_response({"ok": True, "result": {
-            "max_supply": float(MAX_SUPPLY),
-            "circulating_supply": float(get_circulating_supply(last_id)),
-            "last_block": _json_block(last_block),
-        }})
+        async def build():
+            last_block = await self.state.get_last_block()
+            last_id = last_block["id"] if last_block else 0
+            return {"ok": True, "result": {
+                "max_supply": float(MAX_SUPPLY),
+                "circulating_supply": float(get_circulating_supply(last_id)),
+                "last_block": _json_block(last_block),
+            }}
+
+        return await self._cached(request, "supply", (), build)
 
     async def h_send_to_address(self, request: web.Request) -> web.Response:
         """Localhost-only custodial send (main.py:481-518): looks up the
@@ -1625,6 +1814,7 @@ class Node:
             r.add_get("/debug/traces", self.h_debug_traces)
             r.add_get("/debug/events", self.h_debug_events)
             r.add_get("/debug/breakers", self.h_debug_breakers)
+            r.add_get("/debug/cache", self.h_debug_cache)
             if self.config.profile.enabled:
                 r.add_get("/debug/profile", self.h_debug_profile)
         if self.config.ws.enabled:

@@ -160,6 +160,9 @@ class PgChainState(StateViews):
         # the full index resync if the transaction actually touched it
         self._pending_gen = 0  # bumped on every LOCAL mempool mutation
         self.reinject_reorg_txs = False  # Node flips this from config
+        # reorg notification for the hot-state read cache — same hook
+        # as the sqlite backend (ChainState.on_blocks_removed)
+        self.on_blocks_removed = None
 
     def _writer(self):
         if self._write_lock is None:
@@ -460,6 +463,8 @@ class PgChainState(StateViews):
         if not self._owns_txn():
             async with self._writer():
                 await self._aindex_rebuild()
+        if self.on_blocks_removed is not None:
+            self.on_blocks_removed(from_block_id)
 
     async def _restore_spent_outputs(self, inputs: List[TxInput]) -> None:
         for tx_input in inputs:

@@ -181,6 +181,12 @@ class ChainState(StateViews):
         # turns it on from MempoolConfig — off at the library level so
         # state-only embedders keep the reference rollback semantics)
         self.reinject_reorg_txs = False
+        # reorg notification (state/hotcache.py): called with the first
+        # removed block id AFTER a remove_blocks rollback commits.  Sync
+        # and swarm heal call remove_blocks directly on state, so the
+        # read cache's generation hook has to live here rather than on
+        # the BlockManager.
+        self.on_blocks_removed = None
         from collections import OrderedDict as _OD
 
         self._amount_cache: "_OD[tuple, object]" = _OD()
@@ -469,6 +475,8 @@ class ChainState(StateViews):
         self._pending_gen += 1
         self._commit()
         self._index_rebuild()  # reorgs are rare; a bulk resync is ms
+        if self.on_blocks_removed is not None:
+            self.on_blocks_removed(from_block_id)
 
     async def _reinject_pending(self, tx) -> bool:
         """INSERT-OR-IGNORE a reorged-out tx back into the journal.

@@ -60,6 +60,12 @@ def swarm_config(ws: bool = False, ws_queue_max: int = 0,
     # default rings are sized for one
     cfg.telemetry.trace_recent = 512
     cfg.telemetry.events_buffer = 4096
+    # every node is the sole writer of its in-memory state, so the
+    # read cache never needs foreign-writer revalidation — leaving it
+    # on would let the periodic re-anchor mask a missing invalidation
+    # hook (the partition_heal assertion wants the HOOK, not the
+    # backstop, to invalidate losers' caches after their reorg)
+    cfg.cache.revalidate_interval = -1.0
     return cfg
 
 

@@ -142,6 +142,18 @@ async def scenario_partition_heal(swarm: Swarm, seed: int):
     diverged = len({t["hash"] for t in tips}) == 2
     flips_during_partition = _breaker_flips(swarm)
 
+    # warm every loser's hot-state read cache with fork-B answers: the
+    # post-heal reads below must come back reorged, proving the
+    # remove_blocks -> cache-generation hook fired (swarm nodes run
+    # with foreign revalidation off, so ONLY the hook can invalidate)
+    stale_balances = {}
+    for i in b_idx:
+        supply = await swarm.get(i, "get_supply_info", {})
+        info = await swarm.get(i, "get_address_info",
+                               {"address": addr_shared})
+        stale_balances[i] = (supply["result"]["last_block"].get("hash"),
+                             info["result"]["balance"])
+
     swarm.matrix.heal()
     await asyncio.sleep(BREAKER_REOPEN_PAUSE)
     heal_results = []
@@ -152,6 +164,25 @@ async def scenario_partition_heal(swarm: Swarm, seed: int):
     await swarm.settle()
     converged = await swarm.wait_converged()
     tips = await swarm.tips()
+
+    # same queries again, same (warm) caches: a loser still serving its
+    # fork-B tip or balance here means its reorg never invalidated the
+    # read cache — the exact stale-balance bug the generation anchor
+    # exists to prevent
+    winner_info = await swarm.get(0, "get_address_info",
+                                  {"address": addr_shared})
+    winner_balance = winner_info["result"]["balance"]
+    healed_reads_fresh = True
+    stale_differed = False
+    for i in b_idx:
+        supply = await swarm.get(i, "get_supply_info", {})
+        info = await swarm.get(i, "get_address_info",
+                               {"address": addr_shared})
+        if supply["result"]["last_block"].get("hash") != tips[0]["hash"] \
+                or info["result"]["balance"] != winner_balance:
+            healed_reads_fresh = False
+        if stale_balances[i][1] != winner_balance:
+            stale_differed = True
 
     reorgs = telemetry.events.snapshot(kind="reorg")
     roots = _roots_for(heal_tid)
@@ -169,6 +200,9 @@ async def scenario_partition_heal(swarm: Swarm, seed: int):
         "trace_spans_nodes": ("http.sync_blockchain" in root_names
                               and "http.get_blocks" in root_names),
         "breakers_flipped_during_partition": flips_during_partition > 0,
+        # both legs matter: the pre-heal answers really were different
+        # (the check bites) AND the post-heal cached reads are fresh
+        "loser_caches_invalidated": stale_differed and healed_reads_fresh,
     }
     observed = {
         "heal_trace_id": heal_tid,
@@ -176,6 +210,10 @@ async def scenario_partition_heal(swarm: Swarm, seed: int):
         "reorg_events": len(reorgs),
         "heal_trace_roots": len(roots),
         "breaker_flips": _breaker_flips(swarm),
+        "winner_balance": winner_balance,
+        "loser_cache_stats": {
+            str(i): swarm.nodes[i].hotcache.stats()["foreign_bumps"]
+            for i in b_idx},
     }
     return core, observed
 

@@ -166,6 +166,14 @@ class BlockManager:
         # drops mined txs immediately instead of waiting for the next
         # stamp reconcile to notice the journal moved.
         self.on_pending_removed = None
+        # hot-state cache notification (state/hotcache.py): called with
+        # no arguments after ANY committed chain mutation this manager
+        # performs (block accept on either path).  The node points this
+        # at HotStateCache.bump so the read cache's generation advances
+        # the moment the new tip is visible — reorgs are covered by the
+        # storage-level ChainState.on_blocks_removed hook instead, since
+        # sync calls remove_blocks directly on state.
+        self.on_state_committed = None
         # one acceptance at a time: check_block suspends (sql, executor
         # dispatch), so two concurrent push_block handlers could both
         # validate against tip N and race the same block id into the
@@ -179,6 +187,10 @@ class BlockManager:
     def _notify_pending_removed(self, hashes: List[str]) -> None:
         if self.on_pending_removed is not None and hashes:
             self.on_pending_removed(hashes)
+
+    def _notify_committed(self) -> None:
+        if self.on_state_committed is not None:
+            self.on_state_committed()
 
     @staticmethod
     def device_health() -> dict:
@@ -456,6 +468,7 @@ class BlockManager:
         with span("block.mempool_remove"):
             self._notify_pending_removed(
                 [tx.hash() for tx in transactions])
+        self._notify_committed()
 
         if block_no % 10 == 0:
             fingerprint = await self.state.get_unspent_outputs_hash()
@@ -529,6 +542,7 @@ class BlockManager:
         with span("block.mempool_remove"):
             self._notify_pending_removed(
                 [tx.hash() for tx in transactions])
+        self._notify_committed()
         self.invalidate_difficulty()
         return True
 

@@ -43,6 +43,13 @@ from ..logger import get_logger
 
 log = get_logger("ws")
 
+# broadcast encoder, module-level so tests can swap in a counting
+# wrapper: broadcast_to_channel serializes each message through this
+# exactly ONCE and fans the shared string out to every subscriber
+# queue — per-subscriber dumps made a 10k-subscriber broadcast pay
+# 10k identical encodes
+_encode = json.dumps
+
 _SUBSCRIBE = {
     "subscribe_block": ("block", True),
     "unsubscribe_block": ("block", False),
@@ -84,10 +91,12 @@ class WsConnection:
         self._bucket_times.append(now)
         return True
 
-    async def send(self, message: dict) -> bool:
+    async def send(self, message) -> bool:
         """Enqueue for the writer task; never blocks on the socket.  A
         full queue sheds this subscriber's OLDEST pending message
-        (drop-slowest).  Returns False once the connection is closed."""
+        (drop-slowest).  Returns False once the connection is closed.
+        ``message`` is a dict (per-connection replies, encoded at write
+        time) or an already-encoded ``str`` shared by a broadcast."""
         if self._closed:
             return False
         if self._queue.maxlen and len(self._queue) == self._queue.maxlen:
@@ -97,13 +106,13 @@ class WsConnection:
         self._queue_event.set()
         return True
 
-    async def _next_queued(self) -> dict:
+    async def _next_queued(self):
         while not self._queue:
             self._queue_event.clear()
             await self._queue_event.wait()
         return self._queue.popleft()
 
-    async def _send_now(self, message: dict) -> bool:
+    async def _send_now(self, message) -> bool:
         """The actual wire write (writer task only)."""
         try:
             from ..resilience.faultinject import get_injector
@@ -113,7 +122,8 @@ class WsConnection:
                 # chaos hook: a hung/errored subscriber — the hub must
                 # reap it and keep broadcasting to everyone else
                 await injector.fire("ws.send", self.ip)
-            payload = json.dumps(message)
+            payload = message if isinstance(message, str) \
+                else _encode(message)
             await self.ws.send_str(payload)
             self.messages_out += 1
             self.bytes_out += len(payload)
@@ -279,14 +289,17 @@ class WsHub:
         socket_manager.py:201-231).  Returns the number of subscribers
         the message was queued for; wire delivery and dead-subscriber
         reaping happen in the per-connection writers, so a stalled
-        client costs the broadcast nothing."""
+        client costs the broadcast nothing.  The payload is encoded
+        ONCE here; every subscriber queue holds the same shared
+        string."""
         sent = 0
+        payload = _encode(message)
         for conn_id in list(self.channels.get(channel, ())):
             conn = self.connections.get(conn_id)
             if conn is None:
                 self.channels[channel].discard(conn_id)
                 continue
-            if await conn.send(message):
+            if await conn.send(payload):
                 sent += 1
             else:
                 self._drop(conn)
