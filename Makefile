@@ -1,10 +1,11 @@
 PYTHON ?= python
 
-.PHONY: lint test ruff metrics-check perf-observatory perf-smoke swarm
+.PHONY: lint test ruff metrics-check perf-observatory perf-smoke swarm \
+	device-runtime-smoke
 
 # Domain linter: consensus-endianness, consensus-purity, jit-purity,
-# dtype-hygiene, async-safety, broad-except.  Stdlib-only; exits 1 on
-# any unsuppressed error.
+# dtype-hygiene, async-safety, broad-except, device-runtime purity.
+# Stdlib-only; exits 1 on any unsuppressed error.
 lint:
 	$(PYTHON) -m upow_tpu.lint upow_tpu/
 	@$(MAKE) --no-print-directory ruff
@@ -47,11 +48,20 @@ swarm:
 
 # CI-sized variant: tiny population, no PROGRESS append.  Gates
 # (report-only) against the committed artifact so every metric —
-# including verify_pipeline and the readpath cache scenario with their
-# explicit direction metadata — is registered with gate.py on each
-# smoke run.  The readpath headline zeroes itself (tripping the gate)
-# if its cached-vs-recomputed byte differential ever diverges.
+# including verify_pipeline, the readpath cache scenario, and the
+# config-14 coresidency scenario with their explicit direction
+# metadata — is registered with gate.py on each smoke run.  The
+# readpath and coresidency headlines zero themselves (tripping the
+# gate) if their byte differentials ever diverge.
 perf-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.loadgen --smoke \
 		--out observatory-smoke.json \
 		--against observatory.json --report-only
+
+# Device-runtime gate (docs/DEVICE_RUNTIME.md): the fairness /
+# coalescing / degrade-flip / arm-failure test matrix, then the DR
+# lint family proving no dispatch path bypasses the runtime.
+device-runtime-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_device_runtime.py -q \
+		-p no:cacheprovider
+	$(PYTHON) -m upow_tpu.lint upow_tpu/ --select DR001,DR002,DR003

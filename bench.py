@@ -109,6 +109,24 @@ def _attach_last_good(result: dict) -> dict:
 
 _ARM_FAILURE_ENV = "UPOW_BENCH_ARM_FAILURE"
 _ARM_ATTEMPTED_ENV = "UPOW_BENCH_ATTEMPTED_BACKEND"
+_ARM_ATTEMPT_ENV = "UPOW_BENCH_ARM_ATTEMPT"
+
+# Same file/format as tpu_watch.py's event log, so the watcher's
+# timeline and the bench's own arm story interleave in one place.
+_BENCH_EVENTS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".bench_events.jsonl")
+
+
+def _record_bench_event(kind: str, **fields) -> None:
+    """Append one event line to .bench_events.jsonl (tpu_watch format);
+    never let bookkeeping take the bench down."""
+    entry = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S%z"), "kind": kind,
+             **fields}
+    try:
+        with open(_BENCH_EVENTS, "a") as f:
+            f.write(json.dumps(entry, sort_keys=True) + "\n")
+    except OSError as e:
+        sys.stderr.write(f"bench event not recorded: {e}\n")
 
 
 def _emit_arm_failed(reason: str, attempted: str = "tpu") -> None:
@@ -130,6 +148,7 @@ def _attach_arm_provenance(result: dict, platform=None) -> dict:
     result["attempted_backend"] = os.environ.get(
         _ARM_ATTEMPTED_ENV, platform)
     result["arm_failure_reason"] = os.environ.get(_ARM_FAILURE_ENV)
+    result["arm_attempt"] = os.environ.get(_ARM_ATTEMPT_ENV)
     return result
 
 
@@ -150,6 +169,7 @@ def _reexec_cpu_child(reason: str) -> int:
     env["JAX_PLATFORMS"] = "cpu"
     env[_ARM_FAILURE_ENV] = reason
     env[_ARM_ATTEMPTED_ENV] = "tpu"
+    env[_ARM_ATTEMPT_ENV] = "cpu-child"
     proc = subprocess.run([sys.executable] + sys.argv, env=env)
     return proc.returncode
 
@@ -338,7 +358,47 @@ def main() -> int:
     compile_cache.enable(
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
 
-    platform = _init_jax_backend()
+    if os.environ.get(_CPU_CHILD_MARKER):
+        os.environ.setdefault(_ARM_ATTEMPT_ENV, "cpu-child")
+        platform = _init_jax_backend()
+    else:
+        # Arm through the device-runtime service (the one sanctioned
+        # dispatch issuer).  Attempt 1: normal arm.  Attempt 2: in-process
+        # re-arm with a scrubbed env — stale plugin vars are the common
+        # hang cause, and an in-process retry is much cheaper than the
+        # re-exec'd child.  Only if BOTH fail do we fall back to the
+        # scrubbed-env CPU child re-exec.
+        from upow_tpu.device.runtime import get_runtime
+
+        os.environ[_ARM_ATTEMPT_ENV] = "runtime"
+        info = get_runtime().arm(attempt="runtime")
+        platform = info.get("platform")
+        if platform is None:
+            reason = (info.get("arm_failure_reason")
+                      or "backend probe hung/failed")
+            sys.stderr.write(
+                f"runtime arm failed ({reason}); retrying with scrubbed env\n")
+            _record_bench_event("bench_arm_retry", attempt="runtime",
+                                reason=reason)
+            os.environ[_ARM_ATTEMPT_ENV] = "runtime-scrubbed-env"
+            info = get_runtime().arm(scrub_env=True, force=True,
+                                     attempt="runtime-scrubbed-env")
+            platform = info.get("platform")
+            if platform is not None:
+                # the scrub pins JAX_PLATFORMS=cpu, so this attempt can
+                # only land on cpu — record why attempt 1 lost the chip
+                os.environ.setdefault(_ARM_FAILURE_ENV, reason)
+                os.environ.setdefault(_ARM_ATTEMPTED_ENV, "tpu")
+    if platform == "cpu" and not os.environ.get(_CPU_CHILD_MARKER):
+        # armed, but the probe only ever saw cpu — record it so the
+        # emitted line distinguishes "cpu host" from "tpu degraded"
+        os.environ.setdefault(_ARM_FAILURE_ENV, "only cpu visible to jax")
+        os.environ.setdefault(_ARM_ATTEMPTED_ENV, "tpu")
+        _emit_arm_failed(os.environ[_ARM_FAILURE_ENV])
+    _record_bench_event(
+        "bench_arm", attempt=os.environ.get(_ARM_ATTEMPT_ENV, "runtime"),
+        platform=platform or "none",
+        reason=os.environ.get(_ARM_FAILURE_ENV))
     if platform is None:
         if os.environ.get(_CPU_CHILD_MARKER):
             # even the clean CPU child failed: emit the honest zero line
@@ -353,19 +413,14 @@ def main() -> int:
         if args.require_tpu:
             sys.stderr.write("--require-tpu: backend hung, not falling back\n")
             return 3
-        reason = "backend probe hung/failed; scrubbed-env cpu child fallback"
+        reason = ("backend probe hung/failed twice (runtime + scrubbed env); "
+                  "scrubbed-env cpu child fallback")
         _emit_arm_failed(reason)
         sys.stderr.write("falling back to scrubbed-env CPU child\n")
         return _reexec_cpu_child(reason)
     if args.require_tpu and platform == "cpu":
         sys.stderr.write("--require-tpu: only cpu available\n")
         return 3
-    if platform == "cpu" and not os.environ.get(_CPU_CHILD_MARKER):
-        # armed, but the probe only ever saw cpu — record it so the
-        # emitted line distinguishes "cpu host" from "tpu degraded"
-        os.environ.setdefault(_ARM_FAILURE_ENV, "only cpu visible to jax")
-        os.environ.setdefault(_ARM_ATTEMPTED_ENV, "tpu")
-        _emit_arm_failed(os.environ[_ARM_FAILURE_ENV])
     if args.batch == 0:
         args.batch = 1 << 20 if platform == "cpu" else 1 << 28
     if platform == "cpu" and args.batch > 1 << 20:

@@ -20,6 +20,9 @@ configs, one JSON line each.
     cache, steady state) vs serial per-tx host dispatch + differential
 13. readpath: block-anchored hot-state read cache vs the bypassed SQL
     path under block cadence, byte-identity differential built in
+14. coresidency: miner + block verify + mempool intake sharing ONE
+    device runtime — cross-source coalescing and fairness deltas,
+    byte-identity differential built in
 
 ``bench.py`` stays the driver-facing single-line headline (sha256
 search + the verify sub-metric); this suite is the full scoreboard.
@@ -597,6 +600,35 @@ def config13_readpath_cache(seconds: float):
           None, direction="higher")
 
 
+def config14_coresidency(seconds: float):
+    """Co-residency on the device runtime (ISSUE 10 acceptance):
+    saturating miner stream + block-verify + mempool-intake sig batches
+    on ONE DeviceRuntime, with the built-in differential — every
+    concurrent verdict byte-identical to the serial host reference AND
+    a serial one-dispatch-per-batch pass — required before any number
+    is emitted.  Headlines: cross-source coalescing ratio (fewer
+    dispatches), shared-dispatch occupancy, and the block-verify queue
+    wait under the flood (bounded wait = no starvation)."""
+    from upow_tpu import telemetry
+    from upow_tpu.loadgen.coresidency import (CoresidencySpec,
+                                              run_coresidency)
+
+    telemetry.configure()
+    r = run_coresidency(
+        CoresidencySpec() if seconds >= 4 else CoresidencySpec.smoke())
+    assert r["differential"]["ok"], \
+        "coresidency differential diverged from the serial paths"
+    _emit("coresidency_coalesce_ratio", r["coalesce_ratio"], "x", None,
+          direction="higher")
+    _emit("coresidency_dispatch_reduction", r["dispatch_reduction"], "x",
+          None, direction="higher")
+    _emit("coresidency_occupancy", r["concurrent"]["occupancy"] or 0.0,
+          "ratio", None, direction="higher")
+    _emit("coresidency_verify_wait_p99",
+          r["concurrent"]["verify_wait_p99_ms"], "ms", None,
+          direction="lower")
+
+
 def config9_sync(seconds: float):
     """End-to-end chain sync over real localhost HTTP: node B downloads
     node A's chain in pages (prefetch pipeline, page-level signature
@@ -737,6 +769,7 @@ def main() -> int:
         "11": lambda: config11_perf_observatory(args.seconds),
         "12": lambda: config12_verify_pipeline(args.seconds),
         "13": lambda: config13_readpath_cache(args.seconds),
+        "14": lambda: config14_coresidency(args.seconds),
     }
     needs_device = {"2", "3", "5", "7"}
     failed = []

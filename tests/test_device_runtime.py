@@ -1,0 +1,402 @@
+"""Device-runtime service tests (ISSUE 10): cross-source coalescing
+differential, weighted fairness under a saturating miner flood, the
+degrade choke point (flip mid-flight drains queued work to the host,
+byte-identical), the arm-failure path (every subsystem served on CPU,
+no deadlock), and the ``device.runtime`` fault site.
+"""
+
+import threading
+import time
+
+import pytest
+
+from upow_tpu import telemetry
+from upow_tpu.benchutil import pipeline_verify_fixture
+from upow_tpu.config import DeviceRuntimeConfig
+from upow_tpu.device.runtime import DeviceRuntime, boxed_call
+from upow_tpu.resilience import faultinject
+from upow_tpu.resilience.degrade import DegradeManager
+from upow_tpu.telemetry import metrics
+from upow_tpu.verify import txverify
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    telemetry.reset()
+    telemetry.configure()
+    txverify.clear_sig_verdicts()
+    yield
+    txverify.clear_sig_verdicts()
+    telemetry.reset()
+    telemetry.configure()
+
+
+@pytest.fixture
+def rt():
+    runtime = DeviceRuntime()
+    yield runtime
+    runtime.close()
+
+
+def _host_compute(checks):
+    """Reference verdicts through the single-sig host path — the
+    semantics every runtime-coalesced dispatch must match."""
+    return [bool(txverify._host_verify_digest(c[0], c[2], c[3])
+                 or txverify._host_verify_digest(c[1], c[2], c[3]))
+            for c in checks]
+
+
+# ---------------------------------------------- coalescing differential ----
+
+def test_32_source_coalescing_differential(rt):
+    """32 subsystems submit compatible sig batches concurrently; the
+    runtime serves them in ONE dispatch and every source gets exactly
+    the serial host path's verdicts back."""
+    checks = pipeline_verify_fixture(64, n_unique=16, invalid_every=5)
+    slices = [checks[i * 2: i * 2 + 2] for i in range(32)]
+    expected = [_host_compute(s) for s in slices]
+
+    with rt.hold():
+        futs = [
+            rt.submit_sig_checks(s, backend="host", device_timeout=30.0,
+                                 source="src%02d" % i)
+            for i, s in enumerate(slices)
+        ]
+        # all 32 queued while held: nothing dispatched yet
+        assert rt.dispatches == 0
+        assert rt.submissions == 32
+    got = [f.result(timeout=60.0) for f in futs]
+
+    assert got == expected
+    assert rt.dispatches == 1  # 32 submissions -> one shared dispatch
+    st = rt.stats()
+    assert len(st["per_source"]) == 32
+    assert all(v == 1 for v in st["per_source"].values())
+
+
+def test_incompatible_keys_do_not_coalesce(rt):
+    """Different dispatch keys (pad_block) stay in separate dispatches —
+    coalescing must never change WHAT is computed."""
+    checks = pipeline_verify_fixture(8, n_unique=4, invalid_every=3)
+    with rt.hold():
+        f1 = rt.submit_sig_checks(checks[:4], backend="host",
+                                  pad_block=128, source="a")
+        f2 = rt.submit_sig_checks(checks[4:], backend="host",
+                                  pad_block=64, source="b")
+    assert f1.result(60.0) == _host_compute(checks[:4])
+    assert f2.result(60.0) == _host_compute(checks[4:])
+    assert rt.dispatches == 2
+
+
+def test_max_coalesce_caps_group_size():
+    cfg = DeviceRuntimeConfig(max_coalesce=4)
+    rt = DeviceRuntime(cfg)
+    try:
+        checks = pipeline_verify_fixture(16, n_unique=8, invalid_every=4)
+        with rt.hold():
+            futs = [rt.submit_sig_checks([c], backend="host",
+                                         source="s%d" % i)
+                    for i, c in enumerate(checks)]
+        got = [f.result(60.0) for f in futs]
+        assert got == [[v] for v in _host_compute(checks)]
+        assert rt.dispatches == 4  # 16 submissions / cap 4
+    finally:
+        rt.close()
+
+
+# ------------------------------------------------------------- fairness ----
+
+def test_miner_flood_cannot_starve_block_verify(rt):
+    """A saturating 'mine' stream (weight 1) queued ahead of a burst of
+    'block' items (weight 4): the block items are served near the front
+    and their queue wait stays bounded while the flood drains."""
+    served = []
+
+    def work(tag):
+        def fn():
+            served.append(tag)
+            time.sleep(0.002)
+        return fn
+
+    n_mine, n_block = 120, 5
+    with rt.hold():
+        mine_futs = [rt.submit_call(work("mine"), kernel="pow",
+                                    source="mine") for _ in range(n_mine)]
+        block_futs = [rt.submit_call(work("block"), kernel="verify",
+                                     source="block") for _ in range(n_block)]
+    for f in block_futs + mine_futs:
+        f.result(timeout=60.0)
+
+    # all five block items served within the first handful of slots even
+    # though 120 miner items were queued first
+    block_pos = [i for i, tag in enumerate(served) if tag == "block"]
+    assert len(block_pos) == n_block
+    assert max(block_pos) < 12, served[:16]
+
+    waits = rt.stats()["queue_waits"]
+    # the flood's tail waits for the whole drain; block verify does not
+    assert max(waits["block"]) < max(waits["mine"]) / 4
+
+
+def test_idle_source_cannot_bank_credit(rt):
+    """A source waking from idle starts at the current virtual time —
+    idleness is not a stored entitlement to a monopoly burst."""
+    with rt.hold():
+        for _ in range(10):
+            rt.submit_call(lambda: None, source="mine")
+    [f.result(30.0) for f in [rt.submit_call(lambda: "x", source="mine")]]
+    # vtime has advanced; a brand-new source starts AT it, not at zero
+    with rt._cv:
+        vtime = rt._vtime
+    assert vtime > 0
+    with rt.hold():
+        fut = rt.submit_call(lambda: "y", source="late")
+        with rt._cv:
+            assert rt._passes["late"] >= vtime
+    assert fut.result(30.0) == "y"
+
+
+# ------------------------------------------------------ degrade choke ----
+
+def test_degrade_flip_mid_flight_drains_host_byte_identical(rt, monkeypatch):
+    """Items queued BEFORE a degrade flip execute AFTER it on the host
+    path (backend resolution happens at pop time, not submit time) and
+    the verdicts are byte-identical to the serial host path."""
+    mgr = DegradeManager(failure_limit=1, cooldown=3600.0)
+    monkeypatch.setattr(txverify, "DEGRADE", mgr)
+    checks = pipeline_verify_fixture(24, n_unique=8, invalid_every=4)
+    expected = _host_compute(checks)
+
+    state_at_execute = []
+    real = txverify.run_sig_checks
+
+    def spy(cks, **kw):
+        state_at_execute.append(txverify.DEGRADE.state)
+        return real(cks, **kw)
+
+    monkeypatch.setattr(txverify, "run_sig_checks", spy)
+
+    with rt.hold():
+        fut = rt.submit_sig_checks(checks, backend="auto",
+                                   device_timeout=30.0, source="block")
+        # the flip happens while the batch is still queued
+        mgr.record_failure(RuntimeError("device went sick"))
+        assert mgr.state == "degraded"
+    assert fut.result(60.0) == expected
+    # the dispatch ran after the flip and saw the degraded state (the
+    # cache layer re-enters run_sig_checks for misses, hence >= 1 call)
+    assert state_at_execute and set(state_at_execute) == {"degraded"}
+
+
+def test_degrade_runtime_fault_site_drains_host(rt, monkeypatch):
+    """An injected device.runtime fault records a degrade failure and
+    re-runs the group on the host — callers get byte-identical verdicts
+    and never see the fault."""
+    mgr = DegradeManager(failure_limit=1, cooldown=3600.0)
+    monkeypatch.setattr(txverify, "DEGRADE", mgr)
+    checks = pipeline_verify_fixture(16, n_unique=8, invalid_every=3)
+    expected = _host_compute(checks)
+    faultinject.install("device.runtime:error:times=1", seed=1337)
+    try:
+        fut = rt.submit_sig_checks(checks, backend="host",
+                                   device_timeout=30.0, source="mempool")
+        assert fut.result(60.0) == expected
+    finally:
+        faultinject.uninstall()
+    assert mgr.state == "degraded"
+    assert mgr.snapshot()["consecutive_failures"] == 1
+    assert metrics.counters().get("runtime.faults", 0) == 1
+
+
+def test_fault_site_on_boxed_call_surfaces_as_status(rt):
+    """submit_call's boxed mode turns an injected dispatch fault into
+    the ('err', exc) status tuple — the caller's own degrade policy
+    decides, exactly like the pre-runtime boxed_call contract."""
+    faultinject.install("device.runtime:error:times=1", seed=7)
+    try:
+        status, value = rt.run_boxed(lambda: 42, timeout=10.0,
+                                     kernel="probe", source="bench")
+    finally:
+        faultinject.uninstall()
+    assert status == "err"
+    assert isinstance(value, faultinject.FaultInjected)
+    # the injector is spent: the next dispatch is clean
+    assert rt.run_boxed(lambda: 42, timeout=10.0) == ("ok", 42)
+
+
+# ----------------------------------------------------- arm failure ----
+
+def test_arm_failure_serves_every_subsystem_on_cpu(monkeypatch):
+    """A probe that hangs/fails arms the runtime WITHOUT a backend:
+    platform() is None, devices() is [], and sig/call submissions from
+    every source still complete on host paths without deadlock."""
+    from upow_tpu import benchutil
+
+    monkeypatch.setattr(benchutil, "probed_platform_cached",
+                        lambda timeout: None)
+    monkeypatch.setattr(txverify, "DEGRADE",
+                        DegradeManager(failure_limit=3, cooldown=3600.0))
+    rt = DeviceRuntime(DeviceRuntimeConfig(arm_timeout=5.0))
+    try:
+        assert rt.platform() is None
+        assert rt.devices() == []
+        arm = rt.stats()["arm"]
+        assert arm["armed"] and arm["platform"] is None
+        assert "hung/failed" in arm["arm_failure_reason"]
+
+        checks = pipeline_verify_fixture(12, n_unique=6, invalid_every=4)
+        expected = _host_compute(checks)
+        futs = [rt.submit_sig_checks(checks, backend="auto",
+                                     device_timeout=10.0, source=s)
+                for s in ("block", "mempool", "verify")]
+        calls = [rt.submit_call(lambda i=i: i * i, source=s)
+                 for i, s in enumerate(("mine", "index", "bench"))]
+        for f in futs:
+            assert f.result(timeout=30.0) == expected
+        assert [c.result(timeout=30.0) for c in calls] == [0, 1, 4]
+    finally:
+        rt.close()
+
+
+def test_arm_failure_reason_in_structured_info(monkeypatch):
+    from upow_tpu import benchutil
+
+    monkeypatch.setattr(benchutil, "probed_platform_cached",
+                        lambda timeout: None)
+    rt = DeviceRuntime(DeviceRuntimeConfig(arm_timeout=3.0))
+    try:
+        info = rt.arm(attempt="test-attempt")
+        assert info["platform"] is None
+        assert info["attempt"] == "test-attempt"
+        assert "within 3s" in info["arm_failure_reason"]
+    finally:
+        rt.close()
+
+
+# -------------------------------------------------- service plumbing ----
+
+def test_run_boxed_matches_boxed_call_contract(rt):
+    assert rt.run_boxed(lambda: "v", timeout=10.0) == ("ok", "v")
+    status, exc = rt.run_boxed(
+        lambda: (_ for _ in ()).throw(ValueError("boom")), timeout=10.0)
+    assert status == "err" and isinstance(exc, ValueError)
+    assert rt.run_boxed(lambda: time.sleep(5), timeout=0.1) \
+        == ("timeout", None)
+
+
+def test_boxed_call_shim_still_exported():
+    """benchutil.boxed_call must keep working (deprecated shim) — the
+    probe path and external callers depend on the exact contract."""
+    from upow_tpu import benchutil
+
+    assert benchutil.boxed_call(lambda: 1, timeout=5.0) == ("ok", 1)
+    assert boxed_call(lambda: 1, timeout=5.0) == ("ok", 1)
+
+
+def test_inline_execution_from_drainer_thread(rt):
+    """A dispatch nested inside a dispatch executes inline — queueing
+    it would deadlock the single drainer thread."""
+    def outer():
+        inner = rt.submit_call(lambda: "nested", source="verify")
+        return inner.result(timeout=1.0)
+
+    fut = rt.submit_call(outer, source="block")
+    assert fut.result(timeout=30.0) == "nested"
+
+
+def test_dispatch_runs_in_submitter_context(rt):
+    """Degrade/fault events emitted inside a dispatch must carry the
+    submitter's trace ID: the drainer enters the submitter's captured
+    contextvars for both call items and coalesced sig groups
+    (regression: tests/test_chaos.py asserts device events have ids)."""
+    import contextvars
+
+    var = contextvars.ContextVar("rt_test_trace", default=None)
+    var.set("submitter-context")
+
+    fut = rt.submit_call(lambda: var.get(), source="verify")
+    assert fut.result(timeout=30.0) == "submitter-context"
+
+    boxed = rt.submit_call(lambda: var.get(), source="verify",
+                           timeout=10.0)
+    assert boxed.result(timeout=30.0) == ("ok", "submitter-context")
+
+    seen = []
+    real = txverify.run_sig_checks
+
+    def spy(checks, **kw):
+        seen.append(var.get())
+        return real(checks, **kw)
+
+    checks = pipeline_verify_fixture(4, n_unique=4, invalid_every=3)
+    try:
+        txverify.run_sig_checks = spy
+        rt.submit_sig_checks(checks, backend="host",
+                             source="block").result(timeout=60.0)
+    finally:
+        txverify.run_sig_checks = real
+    assert seen and seen[0] == "submitter-context"
+
+
+def test_empty_checks_resolve_immediately(rt):
+    assert rt.submit_sig_checks([]).result(timeout=1.0) == []
+
+
+def test_close_fails_pending_and_rejects_new(rt):
+    with rt.hold():
+        fut = rt.submit_call(lambda: 1, source="bench")
+        rt.close()
+    with pytest.raises(RuntimeError):
+        fut.result(timeout=5.0)
+    with pytest.raises(RuntimeError):
+        rt.submit_call(lambda: 2)
+
+
+def test_queue_overflow_rejects():
+    rt = DeviceRuntime(DeviceRuntimeConfig(queue_max=3))
+    try:
+        with rt.hold():
+            for _ in range(3):
+                rt.submit_call(lambda: None, source="bench")
+            with pytest.raises(RuntimeError):
+                rt.submit_call(lambda: None, source="bench")
+    finally:
+        rt.close()
+
+
+def test_runtime_telemetry_families_exported(rt):
+    checks = pipeline_verify_fixture(8, n_unique=4, invalid_every=3)
+    rt.submit_sig_checks(checks, backend="host",
+                         source="block").result(60.0)
+    counters = metrics.counters()
+    assert counters.get("runtime.submissions", 0) >= 1
+    assert counters.get("runtime.dispatches", 0) >= 1
+    assert counters.get("runtime.source.block", 0) >= 1
+    hists = metrics.histograms()
+    assert "runtime.queue_depth" in hists
+    assert "runtime.coalesced" in hists
+    assert "runtime.queue_wait.block" in hists
+
+
+def test_weights_config_parsing():
+    cfg = DeviceRuntimeConfig(weights="block=4, mine = 1,bad")
+    w = cfg.parsed_weights()
+    assert w["block"] == 4 and w["mine"] == 1 and "bad" not in w
+
+
+def test_concurrent_submitters_thread_safe(rt):
+    """Many threads hammering submit while the drainer runs: every
+    future resolves with its own result."""
+    results = {}
+
+    def submitter(i):
+        fut = rt.submit_call(lambda i=i: i * 3, source="s%d" % (i % 4))
+        results[i] = fut.result(timeout=30.0)
+
+    threads = [threading.Thread(target=submitter, args=(i,))
+               for i in range(48)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert results == {i: i * 3 for i in range(48)}

@@ -140,6 +140,34 @@ def test_broad_except_fires():
     assert not any("no finding" in line for line in flagged)
 
 
+# --- device-runtime purity (DR) ------------------------------------------
+
+def test_device_purity_fires():
+    result, fired = rules_fired(FIXTURES / "node" / "bad_device.py")
+    assert {"DR001", "DR002", "DR003"} <= fired
+    by_rule = {}
+    for f in result.findings:
+        by_rule.setdefault(f.rule, []).append(f.line)
+    # jax.devices() + one unsuppressed jax.local_device_count()
+    assert len(by_rule["DR001"]) == 2
+    assert len(by_rule["DR002"]) == 1
+    assert len(by_rule["DR003"]) == 1
+    assert sum(f.rule == "DR001" for f in result.suppressed) == 1
+    # module-level staging, the decorator, and get_runtime() stay clean
+    src = (FIXTURES / "node" / "bad_device.py").read_text().splitlines()
+    flagged = {src[f.line - 1].strip() for f in result.findings}
+    assert not any("no finding" in line for line in flagged)
+
+
+def test_device_purity_scope_excludes_device_dir(tmp_path):
+    device = tmp_path / "device"
+    device.mkdir()
+    f = device / "runtime.py"
+    f.write_text("import jax\nd = jax.devices()\n"
+                 "def g(fn):\n    return boxed_call(fn, 1.0)\n")
+    assert run_lint([str(f)]).findings == []
+
+
 # --- engine contract -----------------------------------------------------
 
 def test_suppress_all_keyword(tmp_path):
@@ -188,7 +216,8 @@ def test_cli_list_rules():
         [sys.executable, "-m", "upow_tpu.lint", "--list-rules"],
         capture_output=True, text=True, cwd=str(PACKAGE.parent))
     assert proc.returncode == 0
-    for rule_id in ("CE001", "CP001", "JP001", "DT001", "AS001", "BE001"):
+    for rule_id in ("CE001", "CP001", "JP001", "DT001", "AS001", "BE001",
+                    "DR001", "DR002", "DR003"):
         assert rule_id in proc.stdout
 
 

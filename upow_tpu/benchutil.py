@@ -21,36 +21,19 @@ from typing import Optional
 
 
 def boxed_call(fn, timeout: float):
-    """Run ``fn`` on a daemon thread with a deadline.
+    """DEPRECATED shim: the hang-survival idiom moved to
+    :func:`upow_tpu.device.runtime.boxed_call` (the device-runtime
+    service is the only sanctioned dispatcher — upowlint rule DR002
+    flags new callers).  Kept delegating because bench tooling and
+    tests monkeypatch ``benchutil.boxed_call`` to fake probe results;
+    :func:`probe_platform` still resolves it through this module global
+    so those seams keep intercepting.
 
     Returns ("ok", result) | ("err", exception) | ("timeout", None).
-    The one home of the hang-survival idiom: a call stuck inside the
-    PJRT client can neither be interrupted nor joined — the daemon
-    thread is abandoned and the caller decides what degraded mode means.
     """
-    import contextvars
-    import threading
+    from .device.runtime import boxed_call as _boxed_call
 
-    box: dict = {}
-    # carry the caller's contextvars into the worker so telemetry
-    # emitted inside the boxed call (fault events, spans) keeps the
-    # caller's trace ID — a bare Thread starts with an empty context
-    ctx = contextvars.copy_context()
-
-    def run():
-        try:
-            box["ok"] = ctx.run(fn)
-        except Exception as e:
-            box["err"] = e
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    t.join(timeout)
-    if "ok" in box:
-        return "ok", box["ok"]
-    if "err" in box:
-        return "err", box["err"]
-    return "timeout", None
+    return _boxed_call(fn, timeout)
 
 
 # Platform strings that mean "a real TPU answers": native libtpu
@@ -67,7 +50,11 @@ def probe_platform(timeout: float = 90.0) -> Optional[str]:
     downstream backend-routing comparison sees one canonical name."""
     import jax
 
-    status, value = boxed_call(lambda: jax.devices()[0].platform, timeout)
+    # module-global boxed_call on purpose: tests monkeypatch it to fake
+    # probe outcomes; jax.devices() here IS the probe the runtime arms
+    # through, not a stray dispatch
+    status, value = boxed_call(  # upowlint: disable=DR002
+        lambda: jax.devices()[0].platform, timeout)  # upowlint: disable=DR001
     if status != "ok":
         return None
     return "tpu" if value in TPU_PLATFORMS else value

@@ -84,6 +84,61 @@ class DeviceConfig:
 
 
 @dataclass
+class DeviceRuntimeConfig:
+    """Per-process device-runtime service (upow_tpu/device/runtime.py,
+    docs/DEVICE_RUNTIME.md).  Operational only — the runtime changes who
+    shares a dispatch, never what is computed, so nodes with different
+    runtime settings stay bit-identical on chain state.  All fields
+    overridable as ``UPOW_DEVICE_RUNTIME_<FIELD>``."""
+
+    arm_timeout: float = 90.0       # backend probe/arm deadline; a hung
+                                    # tunnel costs the process ONE such
+                                    # timeout, then every source runs on
+                                    # the host paths
+    aot_warm: bool = True           # compile the kernel set at arm time
+                                    # (real accelerators only; the CPU
+                                    # XLA fallbacks are never warmed)
+    compile_cache_dir: str = ""     # persistent compile cache root fed
+                                    # to compile_cache.enable() at arm
+                                    # ('' = caller manages it, as
+                                    # bench.py does)
+    weights: str = ("block=4,index=3,mempool=2,verify=2,"
+                    "mine=1,bench=1,other=1")
+                                    # fair-share weights per source; a
+                                    # served item charges cost/weight to
+                                    # its source's virtual pass, so
+                                    # block verify outruns a saturating
+                                    # miner stream 4:1
+    queue_max: int = 8192           # per-source pending-item cap;
+                                    # overflow raises (backpressure)
+    max_coalesce: int = 64          # sig submissions merged into one
+                                    # shared dispatch
+
+    def parsed_weights(self) -> dict:
+        weights = {}
+        for part in self.weights.split(","):
+            name, _, raw = part.strip().partition("=")
+            name, raw = name.strip(), raw.strip()
+            if name and raw:
+                try:
+                    weights[name] = max(1, int(raw))
+                except ValueError:
+                    raise ValueError(
+                        f"device_runtime.weights entry {part!r}: weight "
+                        f"must be an integer") from None
+        return weights
+
+    @classmethod
+    def from_env(cls) -> "DeviceRuntimeConfig":
+        """Defaults + ``UPOW_DEVICE_RUNTIME_*`` env overrides — the
+        runtime singleton arms before any Config object exists, so it
+        reads the same env surface directly."""
+        cfg = cls()
+        _apply_env_fields(cfg, "device_runtime")
+        return cfg
+
+
+@dataclass
 class ResilienceConfig:
     """Retry / circuit-breaker / degradation / fault-injection knobs.
 
@@ -294,6 +349,8 @@ class ProfilingConfig:
 @dataclass
 class Config:
     device: DeviceConfig = field(default_factory=DeviceConfig)
+    device_runtime: DeviceRuntimeConfig = field(
+        default_factory=DeviceRuntimeConfig)
     node: NodeConfig = field(default_factory=NodeConfig)
     ws: WsConfig = field(default_factory=WsConfig)
     miner: MinerConfig = field(default_factory=MinerConfig)
@@ -342,20 +399,27 @@ def _merge_dict(cfg: Config, data: dict) -> Config:
 
 
 def _merge_env(cfg: Config) -> Config:
-    for section in ("device", "node", "ws", "miner", "log", "resilience",
-                    "mempool", "cache", "telemetry", "profile"):
-        sub = getattr(cfg, section)
-        for f in dataclasses.fields(sub):
-            env = f"UPOW_{section.upper()}_{f.name.upper()}"
-            if env in os.environ:
-                raw = os.environ[env]
-                if f.type in ("int", int):
-                    value = int(raw)
-                elif f.type in ("float", float):
-                    value = float(raw)
-                elif f.type in ("bool", bool):
-                    value = raw.lower() in ("1", "true", "yes")
-                else:
-                    value = raw
-                setattr(sub, f.name, value)
+    for section in ("device", "device_runtime", "node", "ws", "miner",
+                    "log", "resilience", "mempool", "cache", "telemetry",
+                    "profile"):
+        _apply_env_fields(getattr(cfg, section), section)
     return cfg
+
+
+def _apply_env_fields(sub, section: str) -> None:
+    """Apply ``UPOW_<SECTION>_<FIELD>`` env overrides onto one config
+    dataclass instance (shared by _merge_env and the sections that must
+    self-load before a Config exists, e.g. DeviceRuntimeConfig)."""
+    for f in dataclasses.fields(sub):
+        env = f"UPOW_{section.upper()}_{f.name.upper()}"
+        if env in os.environ:
+            raw = os.environ[env]
+            if f.type in ("int", int):
+                value = int(raw)
+            elif f.type in ("float", float):
+                value = float(raw)
+            elif f.type in ("bool", bool):
+                value = raw.lower() in ("1", "true", "yes")
+            else:
+                value = raw
+            setattr(sub, f.name, value)

@@ -1395,12 +1395,14 @@ def verify_batch_prehashed(
         return np.zeros(0, dtype=bool)
     # "axon" = the tunnel plugin's PJRT client name for the same TPU
     # hardware (lowering tables are aliased to tpu's) — route it like tpu
-    if backend is None:
-        backend = ("pallas" if jax.default_backend() in ("tpu", "axon")
-                   else "jnp")
-    if scalar_prep is None:
-        scalar_prep = ("device" if jax.default_backend() in ("tpu", "axon")
-                       else "host")
+    if backend is None or scalar_prep is None:
+        from ..device.runtime import get_runtime
+
+        platform = get_runtime().platform()  # probe normalizes axon->tpu
+        if backend is None:
+            backend = "pallas" if platform == "tpu" else "jnp"
+        if scalar_prep is None:
+            scalar_prep = "device" if platform == "tpu" else "host"
     if mesh is not None and backend == "pallas":
         if PALLAS_KERNEL != "jac" or scalar_prep != "device":
             raise ValueError(

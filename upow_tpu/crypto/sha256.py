@@ -210,7 +210,9 @@ def _compress_tail(midstate, w, unroll: bool | None = None):
     accelerator.
     """
     if unroll is None:
-        unroll = jax.default_backend() != "cpu"
+        from ..device.runtime import get_runtime
+
+        unroll = get_runtime().platform() not in (None, "cpu")
     if not unroll:
         return _compress_tail_rolled(midstate, w)
     w = list(w)
@@ -493,11 +495,12 @@ def _measure_txid_crossover(payloads, host_fn):
     import logging
     import time as _t
 
-    from ..benchutil import boxed_call, probed_platform_cached
+    from ..device.runtime import get_runtime
 
     log = logging.getLogger("upow_tpu.crypto")
+    runtime = get_runtime()
     # Operational timeouts/timing below are not consensus data.
-    if probed_platform_cached(timeout=90.0) in (None, "cpu"):  # upowlint: disable=CP001
+    if runtime.platform() in (None, "cpu"):  # upowlint: disable=CP001
         log.info("txid auto: no accelerator; host hashing")
         return "host", None
     t0 = _t.perf_counter()
@@ -507,12 +510,18 @@ def _measure_txid_crossover(payloads, host_fn):
     def device_once():
         return sha256_batch_jnp(payloads)
 
-    status, _ = boxed_call(device_once, timeout=240.0)  # compile warmup  # upowlint: disable=CP001
+    status, _ = runtime.run_boxed(  # compile warmup
+        # operational timeout, not a consensus value
+        device_once, 240.0, kernel="sha256_txid",  # upowlint: disable=CP001
+        source="index")
     if status != "ok":
         log.warning("txid auto: device probe %s; host hashing", status)
         return "host", host_digests
     t0 = _t.perf_counter()
-    status, _ = boxed_call(device_once, timeout=60.0)  # upowlint: disable=CP001
+    status, _ = runtime.run_boxed(
+        # operational timeout, not a consensus value
+        device_once, 60.0, kernel="sha256_txid",  # upowlint: disable=CP001
+        source="index")
     t_dev = _t.perf_counter() - t0
     if status != "ok":
         log.warning("txid auto: device re-run %s; host hashing", status)

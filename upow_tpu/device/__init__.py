@@ -1,0 +1,11 @@
+"""The device-runtime package: the one home of device dispatch.
+
+Everything that touches the accelerator — backend probing/arming,
+thread-boxed dispatch, queueing, cross-subsystem coalescing, AOT
+warmup — lives under ``upow_tpu/device/``.  The upowlint ``DR`` rules
+(lint/rules/devicepurity.py) enforce the boundary: any
+``jax.jit``/``pjit`` dispatch, ``boxed_call``, or backend
+init/enumeration outside this package is a lint error.
+"""
+
+from .runtime import DeviceRuntime, get_runtime, reset_runtime  # noqa: F401

@@ -1,7 +1,7 @@
 """Rule registry. Import order fixes the --list-rules display order."""
 
-from . import (asyncsafety, broadexcept, consensus, dtypes, endianness,
-               jitpurity)
+from . import (asyncsafety, broadexcept, consensus, devicepurity, dtypes,
+               endianness, jitpurity)
 
 ALL_RULES = (
     endianness.RULES
@@ -10,6 +10,7 @@ ALL_RULES = (
     + dtypes.RULES
     + asyncsafety.RULES
     + broadexcept.RULES
+    + devicepurity.RULES
 )
 
 __all__ = ["ALL_RULES"]

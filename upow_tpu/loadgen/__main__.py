@@ -44,18 +44,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="gate reports but never fails the run")
     args = ap.parse_args(argv)
 
+    from .coresidency import CoresidencySpec
     from .observatory import append_progress, run_observatory, write_artifact
     from .readpath import ReadpathSpec
 
     spec = PopulationSpec.smoke() if args.smoke else PopulationSpec()
     rp_spec = ReadpathSpec.smoke() if args.smoke else ReadpathSpec()
+    co_spec = CoresidencySpec.smoke() if args.smoke else CoresidencySpec()
     if args.seed is not None:
         spec.seed = args.seed
         rp_spec.seed = args.seed
+        co_spec.seed = args.seed
 
     artifact = run_observatory(spec, bench_seconds=args.bench_seconds,
                                device=args.device, cost=args.cost,
-                               readpath_spec=rp_spec)
+                               readpath_spec=rp_spec,
+                               coresidency_spec=co_spec)
     write_artifact(artifact, args.out)
     if args.progress:
         append_progress(artifact, args.progress)

@@ -13,6 +13,10 @@ structured JSON artifact:
   cached vs bypassed p99 under block cadence, with its byte-identity
   differential; headline metrics are mirrored into ``kernels`` with
   explicit gate directions.
+* ``coresidency`` — the shared device-runtime scenario
+  (:mod:`.coresidency`): miner + block verify + mempool intake on one
+  runtime, cross-source coalescing and fairness deltas with the same
+  differential-gated mirroring into ``kernels``.
 * ``provenance`` — what actually ran: ``backend``, ``platform``,
   ``attempted_backend``, ``arm_failure_reason``.  BENCH_r02–r05 all
   silently degraded to a scrubbed-env CPU child; this block is the
@@ -142,7 +146,8 @@ def run_observatory(spec: Optional[PopulationSpec] = None,
                     device: bool = False,
                     cost: bool = False,
                     probe_timeout: float = 90.0,
-                    readpath_spec=None) -> dict:
+                    readpath_spec=None,
+                    coresidency_spec=None) -> dict:
     """Run loadgen + kernel benches; return the merged artifact."""
     from .harness import run_against_node
 
@@ -183,6 +188,35 @@ def run_observatory(spec: Optional[PopulationSpec] = None,
                 "value": readpath["cached_pass"]["hit_ratio"],
                 "unit": "ratio", "direction": "higher"}
 
+    coresidency = None
+    try:
+        from .coresidency import CoresidencySpec, run_coresidency
+
+        coresidency = run_coresidency(coresidency_spec
+                                      or CoresidencySpec.smoke())
+    except Exception as e:
+        log.warning("coresidency scenario skipped: %s", e)
+    if coresidency is not None:
+        co_ok = coresidency["differential"]["ok"]
+        # same convention as readpath: divergence already zeroed the
+        # headline and withheld the perf sections; the explicit
+        # directions keep gate.py's token inference out of it
+        kernels["coresidency_coalesce_ratio"] = {
+            "value": coresidency["coalesce_ratio"] or 0.0, "unit": "x",
+            "direction": "higher", "differential_ok": co_ok,
+            "differential_checks": coresidency["differential"]["checks"]}
+        if co_ok:
+            conc = coresidency["concurrent"]
+            kernels["coresidency_dispatch_reduction"] = {
+                "value": coresidency["dispatch_reduction"], "unit": "x",
+                "direction": "higher"}
+            kernels["coresidency_occupancy"] = {
+                "value": conc["occupancy"] or 0.0, "unit": "ratio",
+                "direction": "higher"}
+            kernels["coresidency_verify_wait_p99_ms"] = {
+                "value": conc["verify_wait_p99_ms"], "unit": "ms",
+                "direction": "lower"}
+
     if cost:
         try:
             analysis = _kernel_cost_analysis()
@@ -222,6 +256,8 @@ def run_observatory(spec: Optional[PopulationSpec] = None,
     }
     if readpath is not None:
         artifact["readpath"] = readpath
+    if coresidency is not None:
+        artifact["coresidency"] = coresidency
     return artifact
 
 

@@ -251,10 +251,13 @@ class IntakeCoordinator:
             t_dispatch = time.perf_counter()
             try:
                 with trace.span("mempool.sig_dispatch", n=len(flat)):
-                    # shared batched-dispatch front (verify/dispatch.py):
-                    # an intake batch arriving while block verify has a
-                    # micro-batch in flight coalesces into ONE device
-                    # dispatch with it — verdict semantics unchanged
+                    # shared batched-dispatch front (verify/dispatch.py),
+                    # now a thin client of the process-wide device
+                    # runtime: an intake batch arriving while block
+                    # verify (or the miner, or the device index) has
+                    # work queued coalesces into ONE shared dispatch
+                    # under weighted fair scheduling — verdict
+                    # semantics unchanged
                     verdicts = await get_front().submit(
                         flat, backend=dev.sig_backend,
                         pad_block=dev.verify_pad_block,

@@ -79,14 +79,29 @@ def _make_dispatcher(job: MiningJob, backend: str,
     0 = all visible devices)."""
     if backend not in ("pallas", "jnp", "mesh"):
         return None
+    from ..device.runtime import get_runtime
+
+    runtime = get_runtime()
+
+    def _through_runtime(inner, kernel: str):
+        # dispatch ISSUANCE goes through the device owner (so miner
+        # rounds interleave fairly with verify/index batches); XLA's
+        # async dispatch returns the device handle immediately, and the
+        # caller still blocks on int(handle) — the pipelining depth in
+        # mine() keeps its overlap
+        def dispatch(start: int, count: int):
+            return runtime.submit_call(
+                lambda: inner(start, count), kernel=kernel,
+                source="mine").result()
+
+        return dispatch
+
     template = sha_kernel.make_template(job.prefix)
     spec = sha_kernel.target_spec(job.previous_hash, job.difficulty)
     if backend == "mesh":
-        import jax
-
         from ..parallel.mesh import make_mesh, pow_search_sharded
 
-        devices = jax.devices()
+        devices = runtime.devices()
         if mesh_devices:
             devices = devices[:mesh_devices]
         mesh = make_mesh(devices)
@@ -103,13 +118,13 @@ def _make_dispatcher(job: MiningJob, backend: str,
             per_dev = max(1, (count + n_dev - 1) // n_dev)
             return pow_search_sharded(template, spec, start, per_dev, mesh)
 
-        return dispatch
+        return _through_runtime(dispatch, "sha256_search_mesh")
     fn = sha_kernel.pow_search_pallas if backend == "pallas" else sha_kernel.pow_search_jnp
 
     def dispatch(start: int, count: int):
         return fn(template, spec, nonce_base=start, batch=count)
 
-    return dispatch
+    return _through_runtime(dispatch, "sha256_search")
 
 
 def _make_searcher(job: MiningJob, backend: str) -> Callable[[int, int], Optional[int]]:

@@ -35,8 +35,13 @@ from ..crypto.sha256 import SearchTemplate, TargetSpec
 
 
 def make_mesh(devices=None) -> Mesh:
-    """1-D data-parallel mesh over the given (default: all) devices."""
-    devices = jax.devices() if devices is None else devices
+    """1-D data-parallel mesh over the given devices (default: the
+    armed runtime's view — enumeration goes through the device owner so
+    a dead tunnel surfaces as an arm failure, not a hang here)."""
+    if devices is None:
+        from ..device.runtime import get_runtime
+
+        devices = get_runtime().devices()
     return Mesh(np.array(devices), axis_names=("dp",))
 
 
@@ -114,5 +119,8 @@ def shard_batch_arrays(mesh: Mesh, *arrays):
     out = []
     for a in arrays:
         spec = P(*([None] * (a.ndim - 1) + ["dp"]))
-        out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+        # data placement onto an already-armed mesh, not a dispatch —
+        # callers reach this from inside runtime-submitted work
+        out.append(jax.device_put(  # upowlint: disable=DR001
+            a, NamedSharding(mesh, spec)))
     return tuple(out)

@@ -120,7 +120,9 @@ def analyze_cost(kernel: str, fn, *args,
         import jax
 
         if not hasattr(fn, "lower"):
-            fn = jax.jit(fn, static_argnums=static_argnums)
+            # offline cost analysis lowers the kernel without dispatching;
+            # the profiler is a dev tool outside the runtime's hot path
+            fn = jax.jit(fn, static_argnums=static_argnums)  # upowlint: disable=DR003
         compiled = fn.lower(*args).compile()
         analysis = compiled.cost_analysis()
         # older jax returns a per-computation list; newest a flat dict

@@ -14,9 +14,12 @@ from a compact spec string (``ResilienceConfig.faults`` or
 
 Registered sites: ``rpc.<path>`` (peers.py, per peer RPC attempt),
 ``ws.send`` (ws/hub.py, per outbound frame), ``device.verify``
-(txverify.py), and ``swarm.link`` (swarm/links.py — fires once per
-simulated transfer with key ``"src->dst"``, so ``key=`` can target one
-direction of one link).
+(txverify.py), ``device.runtime`` (device/runtime.py — fires once per
+drained dispatch with key ``"sig:<sources>"`` for coalesced signature
+groups or ``"call:<kernel>"`` for single-kernel calls, so ``key=`` can
+target one subsystem's traffic), and ``swarm.link`` (swarm/links.py —
+fires once per simulated transfer with key ``"src->dst"``, so ``key=``
+can target one direction of one link).
 
 Sites are prefix-matched (``rpc`` matches ``rpc.get_blocks``); ``key``
 substring-filters the per-call key (usually the peer URL).  ``kind`` is

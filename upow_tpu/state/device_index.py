@@ -193,9 +193,16 @@ class DeviceUtxoIndex:
         padded = np.concatenate([
             queries,
             np.full(n - len(queries), np.iinfo(np.int32).min, np.int32)])
-        return np.asarray(
-            _member_mask(self._device_keys(), jnp.asarray(padded))
-        )[: len(fps)]
+        # the searchsorted dispatch goes through the device owner so
+        # index lookups interleave (weight: index=3) with miner/verify
+        # batches instead of racing them for the chip
+        from ..device.runtime import get_runtime
+
+        mask = get_runtime().submit_call(
+            lambda: np.asarray(
+                _member_mask(self._device_keys(), jnp.asarray(padded))),
+            kernel="utxo_index", source="index").result()
+        return mask[: len(fps)]
 
     def maybe_contains_batch(self, outpoints: Sequence[Outpoint]) -> np.ndarray:
         """(N,) bool prefilter: False is definitive absence; True means

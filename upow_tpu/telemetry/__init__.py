@@ -59,6 +59,7 @@ def configure(cfg=None) -> None:
         events.configure(cfg.events_buffer)
     device.preregister("p256_verify")
     device.preregister("sha256_txid")
+    device.preregister_runtime()
     for stage in ("block_decode", "block_sig_wait"):
         device.preregister_stage(stage)
     # shared sig dispatch front (verify/dispatch.py) — deferred import:

@@ -105,6 +105,50 @@ def record_stage(stage: str, seconds: float, items: Optional[int] = None,
                         buckets=OCCUPANCY_BUCKETS)
 
 
+RUNTIME_SOURCES = ("block", "mempool", "mine", "index", "verify",
+                   "bench", "other")
+RUNTIME_QUEUE_DEPTH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 1024,
+                               4096)
+RUNTIME_COALESCE_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 64)
+
+
+def preregister_runtime(sources=RUNTIME_SOURCES) -> None:
+    """Create the device-runtime queue families (device/runtime.py) so
+    /metrics exports them before the first submission: per-source
+    queue-wait histograms and submission counters, the queue-depth and
+    submissions-per-dispatch histograms, and a ``device_runtime``
+    kernel occupancy series for the shared dispatches."""
+    metrics.ensure_histogram("runtime.queue_depth",
+                             RUNTIME_QUEUE_DEPTH_BUCKETS)
+    metrics.ensure_histogram("runtime.coalesced", RUNTIME_COALESCE_BUCKETS)
+    for c in ("submissions", "dispatches", "faults"):
+        metrics.ensure_counter("runtime.%s" % c)
+    for s in sources:
+        metrics.ensure_histogram("runtime.queue_wait.%s" % s,
+                                 DISPATCH_BUCKETS)
+        metrics.ensure_counter("runtime.source.%s" % s)
+    preregister("device_runtime")
+
+
+def record_runtime_dispatch(n_submissions: int,
+                            waits_by_source: Dict[str, float],
+                            depth: int, real: int, padded: int,
+                            seconds: float) -> None:
+    """Record one device-runtime drain: how many submissions shared the
+    dispatch, how long each source's items queued, the queue depth seen
+    at pop time, and the occupancy of the padded batch."""
+    metrics.inc("runtime.dispatches")
+    metrics.observe("runtime.coalesced", n_submissions,
+                    buckets=RUNTIME_COALESCE_BUCKETS)
+    metrics.observe("runtime.queue_depth", max(depth, 1),
+                    buckets=RUNTIME_QUEUE_DEPTH_BUCKETS)
+    for source, wait in waits_by_source.items():
+        metrics.observe("runtime.queue_wait.%s" % source,
+                        max(wait, 0.0), buckets=DISPATCH_BUCKETS)
+    record_batch("device_runtime", real=real, padded=padded,
+                 seconds=seconds)
+
+
 def record_cost(kernel: str, analysis: dict) -> None:
     """Store an XLA ``compiled.cost_analysis()`` estimate for ``kernel``
     (``upow_tpu/profiling``): numeric entries only, keys sanitized to
@@ -140,7 +184,9 @@ def device_memory() -> Dict[str, dict]:
     out: Dict[str, dict] = {}
     try:
         import jax
-        for dev in jax.local_devices():
+        # HBM stat sampling from already-initialized devices (called
+        # post-arm from the telemetry exporter; never first-touch)
+        for dev in jax.local_devices():  # upowlint: disable=DR001
             try:
                 stats = dev.memory_stats()
             except Exception as e:

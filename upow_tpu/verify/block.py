@@ -333,7 +333,10 @@ class BlockManager:
                 # dispatch_fn resolves run_sig_checks_async through THIS
                 # module's globals so the long-standing patch seam
                 # (tests monkeypatch block.run_sig_checks_async) keeps
-                # intercepting the block path behind the shared front
+                # intercepting the block path behind the shared front;
+                # when the seam is pristine the front forwards the
+                # group to the device runtime (source="block", weight 4
+                # — a saturating miner stream cannot starve this)
                 dispatches.append(asyncio.ensure_future(front.submit(
                     chunk_checks, backend=self.sig_backend,
                     pad_block=self.verify_pad_block,

@@ -1,5 +1,13 @@
 """Shared batched-dispatch front for P-256 signature verification.
 
+Now a thin client of the process-wide device runtime
+(device/runtime.py): the front still coalesces per event loop (and
+keeps its counters, metrics, and dispatch_fn injection seams), but a
+group headed for the default dispatch target is forwarded to the
+runtime's queue, where it can share one device dispatch with batches
+from OTHER loops and subsystems (mempool intake + block verify + the
+device UTXO index on one chip).
+
 First slice of ROADMAP item 3 (the co-resident kernel server): every
 subsystem that needs signature verdicts — block verify's micro-batches
 (verify/block.py), mempool intake's coalesced admission batches
@@ -38,6 +46,14 @@ from . import txverify
 log = get_logger("verify.dispatch")
 
 COALESCE_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32)
+
+# The default dispatch target at import time.  A coalesced group whose
+# effective target is still this pristine default is forwarded to the
+# process-wide device runtime (device/runtime.py) where it can share a
+# dispatch with OTHER event loops and subsystems; a group whose target
+# was monkeypatched or explicitly injected dispatches locally so those
+# seams observe exactly the calls they always did.
+_ORIG_ASYNC = txverify.run_sig_checks_async
 
 
 class _Submission:
@@ -128,11 +144,27 @@ class SigDispatchFront:
         t0 = time.perf_counter()
         fn = group[0].dispatch_fn or txverify.run_sig_checks_async
         try:
-            verdicts = await fn(
-                flat, backend=backend, pad_block=pad_block,
-                device_timeout=device_timeout,
-                precomputed=group[0].precomputed,
-                mesh_devices=mesh_devices)
+            if group[0].dispatch_fn is None \
+                    and txverify.run_sig_checks_async is _ORIG_ASYNC:
+                # thin-client path: hand the whole coalesced group to
+                # the device runtime, which owns arming/scheduling and
+                # may merge it with compatible batches from other
+                # sources into one shared dispatch
+                from ..device.runtime import get_runtime
+
+                verdicts = await asyncio.wrap_future(
+                    get_runtime().submit_sig_checks(
+                        flat, backend=backend, pad_block=pad_block,
+                        device_timeout=device_timeout,
+                        mesh_devices=mesh_devices,
+                        precomputed=group[0].precomputed,
+                        source=group[0].source))
+            else:
+                verdicts = await fn(
+                    flat, backend=backend, pad_block=pad_block,
+                    device_timeout=device_timeout,
+                    precomputed=group[0].precomputed,
+                    mesh_devices=mesh_devices)
         except Exception as e:
             # not swallowed: every submitter in the group re-raises it
             log.debug("coalesced sig dispatch failed (%d submissions): %s",

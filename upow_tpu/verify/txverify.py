@@ -156,9 +156,9 @@ def _verify_mesh(mesh_devices: int):
         return None
     with _VERIFY_MESH_LOCK:
         if mesh_devices not in _VERIFY_MESH:
-            import jax
+            from ..device.runtime import get_runtime
 
-            devices = jax.devices()
+            devices = get_runtime().devices()
             n = len(devices) if mesh_devices == 0 else min(
                 mesh_devices, len(devices))
             if n <= 1:
@@ -385,7 +385,7 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
         host, and the node survives."""
         import logging
 
-        from ..benchutil import boxed_call
+        from ..device.runtime import get_runtime
         from ..resilience.faultinject import get_injector
 
         def dispatch():
@@ -404,9 +404,11 @@ def run_sig_checks(checks: Sequence[tuple], backend: str = "auto",
         from .. import trace as _trace
 
         t0 = _time.perf_counter()
-        status, value = boxed_call(
-            dispatch,
-            timeout=device_timeout)  # generous: covers first-call compile
+        # through the device-runtime queue (executes inline when this
+        # already runs on the drainer thread — a coalesced front group)
+        status, value = get_runtime().run_boxed(
+            dispatch, device_timeout,  # generous: covers first compile
+            kernel="p256_verify", source="verify")
         from ..telemetry.device import DISPATCH_BUCKETS as _DISPATCH_BUCKETS
 
         _trace.observe("kernel.p256_verify.dispatch_seconds",
