@@ -53,10 +53,23 @@ swarm:
 # metadata — is registered with gate.py on each smoke run.  The
 # readpath and coresidency headlines zero themselves (tripping the
 # gate) if their byte differentials ever diverge.
+# Report-only overall, but the verify-pipeline and resident-accept
+# kernels are ENFORCED (ISSUE 11): a differential divergence zeroes
+# those headline values, so the enforced gate also catches correctness
+# breaks, not just slowdowns.  Per-metric tolerances are wider than the
+# global band because smoke-sized runs on shared CI hosts are noisy.
 perf-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.loadgen --smoke \
 		--out observatory-smoke.json \
-		--against observatory.json --report-only
+		--against observatory.json --report-only \
+		--enforce kernel.verify_pipeline \
+		--enforce kernel.accept_ \
+		--metric-tolerance kernel.verify_pipeline=0.60 \
+		--metric-tolerance kernel.verify_pipeline_serial=0.60 \
+		--metric-tolerance kernel.verify_pipeline_speedup=0.60 \
+		--metric-tolerance kernel.accept_resident=0.60 \
+		--metric-tolerance kernel.accept_serial=0.60 \
+		--metric-tolerance kernel.accept_scan_speedup=0.60
 
 # Device-runtime gate (docs/DEVICE_RUNTIME.md): the fairness /
 # coalescing / degrade-flip / arm-failure test matrix, then the DR

@@ -107,9 +107,12 @@ def _attach_last_good(result: dict) -> dict:
     return result
 
 
-_ARM_FAILURE_ENV = "UPOW_BENCH_ARM_FAILURE"
-_ARM_ATTEMPTED_ENV = "UPOW_BENCH_ATTEMPTED_BACKEND"
-_ARM_ATTEMPT_ENV = "UPOW_BENCH_ARM_ATTEMPT"
+# env names live in upow_tpu.benchutil so the loadgen observatory can
+# stamp the same arm story into its artifact's provenance block
+from upow_tpu.benchutil import (ARM_ATTEMPT_ENV as _ARM_ATTEMPT_ENV,
+                                ARM_ATTEMPTED_ENV as _ARM_ATTEMPTED_ENV,
+                                ARM_FAILURE_ENV as _ARM_FAILURE_ENV,
+                                arm_provenance_from_env)
 
 # Same file/format as tpu_watch.py's event log, so the watcher's
 # timeline and the bench's own arm story interleave in one place.
@@ -145,10 +148,7 @@ def _attach_arm_provenance(result: dict, platform=None) -> dict:
     """Stamp what was attempted vs what actually ran.  The CPU child
     inherits the parent's failure reason via env, so the single JSON
     line the driver captures carries the whole story."""
-    result["attempted_backend"] = os.environ.get(
-        _ARM_ATTEMPTED_ENV, platform)
-    result["arm_failure_reason"] = os.environ.get(_ARM_FAILURE_ENV)
-    result["arm_attempt"] = os.environ.get(_ARM_ATTEMPT_ENV)
+    result.update(arm_provenance_from_env(platform))
     return result
 
 

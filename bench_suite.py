@@ -23,6 +23,10 @@ configs, one JSON line each.
 14. coresidency: miner + block verify + mempool intake sharing ONE
     device runtime — cross-source coalescing and fairness deltas,
     byte-identity differential built in
+15. accept_resident: end-to-end 8k-tx block accept, SQL membership
+    path vs the HBM-resident fused accept (device probe + digest prep
+    in one dispatch), byte-identity differential incl. forced reorg +
+    re-accept built in
 
 ``bench.py`` stays the driver-facing single-line headline (sha256
 search + the verify sub-metric); this suite is the full scoreboard.
@@ -629,6 +633,30 @@ def config14_coresidency(seconds: float):
           direction="lower")
 
 
+def config15_accept_resident(seconds: float):
+    """HBM-resident UTXO accept path (ISSUE 11 acceptance): end-to-end
+    8k-tx block accept through the host-round-trip path (per-table SQL
+    membership scans) vs the fused resident path (device membership
+    probe + digest prep in ONE runtime dispatch, shadow map consulted
+    only on fingerprint ambiguity).  The byte-identity differential —
+    resident probe vs host shadow map vs SQL, plus the unspent-set
+    fingerprint across a FORCED REORG and re-accept — must hold or the
+    run refuses to emit (the helper zeroes the speedups too)."""
+    from upow_tpu.benchutil import accept_resident_bench
+
+    r = accept_resident_bench(seconds=min(seconds / 4, 1.0))
+    assert r["differential_ok"], \
+        "resident accept differential diverged from the SQL path"
+    _emit(f"accept_resident_8k_{_platform()}", r["resident_tx_s"], "tx/s",
+          r["serial_tx_s"])
+    _emit(f"accept_serial_8k_{_platform()}", r["serial_tx_s"], "tx/s",
+          None)
+    _emit(f"accept_scan_speedup_{_platform()}", r["scan_speedup"], "x",
+          None, direction="higher")
+    _emit("accept_shadow_consults", float(r["shadow_consults"]), "",
+          None, direction="lower")
+
+
 def config9_sync(seconds: float):
     """End-to-end chain sync over real localhost HTTP: node B downloads
     node A's chain in pages (prefetch pipeline, page-level signature
@@ -770,6 +798,7 @@ def main() -> int:
         "12": lambda: config12_verify_pipeline(args.seconds),
         "13": lambda: config13_readpath_cache(args.seconds),
         "14": lambda: config14_coresidency(args.seconds),
+        "15": lambda: config15_accept_resident(args.seconds),
     }
     needs_device = {"2", "3", "5", "7"}
     failed = []

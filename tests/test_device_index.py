@@ -101,6 +101,139 @@ def test_incremental_insert_keeps_keys_sorted():
     assert {i for i in range(100) if mask[i]} == expect
 
 
+def test_twin_collisions_at_full_block_scale(monkeypatch):
+    """8k-tx block scale with every fingerprint shared by a twin pair:
+    the probe kernel declares all of them ambiguous, the shadow map
+    resolves each exactly, and a batched spend of one twin per pair
+    never takes the survivor down with it (ISSUE 11 satellite)."""
+    import upow_tpu.state.device_index as di
+
+    # pairwise collisions: outpoints 2k and 2k+1 share fingerprint k
+    monkeypatch.setattr(
+        di, "fingerprint_batch",
+        lambda ops: np.array([int(o[0], 16) >> 1 for o in ops],
+                             dtype=np.uint64))
+    n = 8192
+    ops = [_op(i) for i in range(n)]
+    idx = di.DeviceUtxoIndex(
+        ops, values=[(i + 1, 0, 4) for i in range(n)])
+    assert idx.stats()["twin_fingerprints"] == n // 2
+
+    absent = [_op(i) for i in range(n, n + 64)]
+    mask = idx.contains_batch(ops + absent)
+    assert mask[:n].all() and not mask[n:].any()
+    # every live probe went through an exact shadow resolution
+    assert idx.stats()["shadow_consults"] >= n
+
+    # one batched block: spend the even twin of every pair, create a
+    # fresh (collision-free at this range's fps) replacement set
+    spent = [_op(i) for i in range(0, n, 2)]
+    created = [_op(i) for i in range(2 * n, 2 * n + n // 2)]
+    idx.apply_block(created, spent,
+                    created_values=[(7, 0, 5)] * len(created))
+    assert not idx.contains_batch(spent).any()
+    # the odd twins all survive their partner's spend
+    assert idx.contains_batch([_op(i) for i in range(1, n, 2)]).all()
+    assert idx.contains_batch(created).all()
+
+    # O(delta) rollback restores the pre-block membership exactly
+    assert idx.rollback_block()
+    after = idx.contains_batch(ops + created)
+    assert after[:n].all() and not after[n:].any()
+    assert len(idx) == n
+
+
+def test_rollback_across_three_blocks_restores_values():
+    """A ≥3-block reorg unwinds the undo log block by block; membership
+    AND the resident value store (amounts) must match the snapshot taken
+    before each block, including re-created spends (ISSUE 11)."""
+    genesis = [_op(i) for i in range(64)]
+    idx = DeviceUtxoIndex(
+        genesis, values=[(10 * (i + 1), 0, 1) for i in range(64)])
+
+    blocks = [
+        ([_op(100), _op(101)], [_op(0), _op(1), _op(2)]),
+        ([_op(200), _op(201), _op(202)], [_op(100), _op(3)]),
+        ([_op(300)], [_op(200), _op(101), _op(4)]),
+    ]
+    universe = genesis + [_op(i) for i in
+                          (100, 101, 200, 201, 202, 300, 999)]
+
+    def snapshot():
+        present, amounts = idx.lookup_batch(universe)
+        return present.tolist(), amounts.tolist()
+
+    snaps = [snapshot()]
+    for height, (created, spent) in enumerate(blocks):
+        idx.apply_block(created, spent,
+                        created_values=[(1000 + height, 0, 2 + height)]
+                        * len(created))
+        snaps.append(snapshot())
+    assert idx.undo_depth() == 3
+    # sanity: each block actually changed the observable state
+    assert len({tuple(s[0]) for s in snaps}) == 4
+
+    for depth in (3, 2, 1):
+        assert idx.undo_depth() == depth
+        assert idx.rollback_block()
+        assert snapshot() == snaps[depth - 1]
+    assert idx.undo_depth() == 0
+    assert not idx.rollback_block()  # exhausted log reports False
+
+
+def test_accept_path_steady_state_zero_shadow_consults():
+    """End-to-end block accept through the fused resident path on a
+    collision-free block: the device probes fire (index.probes grows)
+    and NOT ONE membership answer needed the host shadow map
+    (index.shadow_consults stays flat) — the zero-per-tx-host-round-trip
+    acceptance criterion, asserted on telemetry (ISSUE 11)."""
+    import asyncio
+
+    from upow_tpu.benchutil import chain_with_utxo_fanout, leaf_spends
+    from upow_tpu.core import clock, difficulty
+    from upow_tpu.telemetry import metrics
+
+    async def scenario():
+        state, manager, d, pub, addr, mids, mine_block = \
+            await chain_with_utxo_fanout(8, 4, 0x1DE7)
+        try:
+            state.enable_device_index()
+            assert state.resident_indexes(), "device index failed to arm"
+            manager.fused_accept = True
+            txs = leaf_spends(mids, addr, d, pub)
+            before = dict(metrics.counters())
+            await mine_block(txs)
+            after = dict(metrics.counters())
+
+            # differential: resident probe vs SQL over spends + creations
+            idx = state.resident_indexes()["unspent_outputs"]
+            spent = [i.outpoint for t in txs for i in t.inputs]
+            created = [(t.hash(), 0) for t in txs]
+            sample = spent + created
+            dev = [bool(v) for v in idx.contains_batch(sample)]
+            sql = [bool(v) for v in
+                   await state.outpoints_exist(sample, "unspent_outputs")]
+            assert dev == sql
+            assert idx.stats()["twin_fingerprints"] == 0
+            return before, after
+        finally:
+            state.close()
+
+    start_diff = difficulty.START_DIFFICULTY
+    clock.freeze(1_700_000_000)
+    try:
+        before, after = asyncio.run(scenario())
+    finally:
+        clock.reset()
+        difficulty.START_DIFFICULTY = start_diff
+
+    probes = after.get("index.probes", 0) - before.get("index.probes", 0)
+    consults = (after.get("index.shadow_consults", 0)
+                - before.get("index.shadow_consults", 0))
+    assert probes > 0, "fused accept path never dispatched a probe"
+    assert consults == 0, "steady state accept consulted the host map"
+
+
 def test_apply_block_and_reorg_rollback_roundtrip():
     """Block accept applies (created, spent) in one batched call; a reorg
     rollback applies the inverse and must restore the exact pre-block

@@ -159,6 +159,28 @@ def test_device_purity_fires():
     assert not any("no finding" in line for line in flagged)
 
 
+def test_device_purity_fires_on_resident_index_paths():
+    """The ISSUE 11 resident-index dispatch shortcuts (self-pinned HBM
+    tables, probes around the fair queues, call-time kernel staging)
+    each map to a DR rule — state/ is client code of the runtime."""
+    result, fired = rules_fired(FIXTURES / "state" / "bad_index.py")
+    assert fired == {"DR001", "DR002", "DR003"}
+    by_rule = {}
+    for f in result.findings:
+        by_rule.setdefault(f.rule, []).append(f.line)
+    # jax.device_put + jax.default_backend; the capacity check is
+    # suppressed with a justification
+    assert len(by_rule["DR001"]) == 2
+    assert len(by_rule["DR002"]) == 1
+    assert len(by_rule["DR003"]) == 1
+    assert sum(f.rule == "DR001" for f in result.suppressed) == 1
+    # module-level kernel staging and the runtime-routed index are clean
+    src = (FIXTURES / "state" / "bad_index.py").read_text().splitlines()
+    flagged = {src[f.line - 1].strip() for f in result.findings}
+    assert not any("no finding" in line for line in flagged)
+    assert not any("probe_staged = " in line for line in flagged)
+
+
 def test_device_purity_scope_excludes_device_dir(tmp_path):
     device = tmp_path / "device"
     device.mkdir()

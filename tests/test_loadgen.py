@@ -194,6 +194,40 @@ def test_gate_report_only_and_tolerance_flags(tmp_path):
                       "--tolerance", "2.0"]) == 0
 
 
+def test_gate_enforce_overrides_report_only(tmp_path, capsys):
+    """--enforce SUBSTR promotes matching metrics to hard-gating even
+    under --report-only (the make perf-smoke contract), and
+    --metric-tolerance NAME=TOL pins a per-metric band."""
+    base = _write(tmp_path, "base.json", _artifact())
+    cur = _write(tmp_path, "cur.json", _artifact(p95=20.0, kernel=1.0))
+    # report-only hides both regressions ...
+    assert gate.main(["--against", base, "--current", cur,
+                      "--report-only"]) == 0
+    capsys.readouterr()
+    # ... but an enforced substring match fails the gate
+    assert gate.main(["--against", base, "--current", cur,
+                      "--report-only",
+                      "--enforce", "kernel.search_"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["enforced_regressions"] == 1
+    # a wide per-metric band rescues ONLY the named metric
+    assert gate.main(["--against", base, "--current", cur,
+                      "--report-only", "--enforce", "kernel.search_",
+                      "--metric-tolerance",
+                      "kernel.search_python_loop=0.9"]) == 0
+    capsys.readouterr()
+    # the per-metric band also TIGHTENS: in-band globally, enforced out
+    cur2 = _write(tmp_path, "cur2.json", _artifact(kernel=4.5))
+    assert gate.main(["--against", base, "--current", cur2,
+                      "--report-only", "--enforce", "kernel.search_",
+                      "--metric-tolerance",
+                      "kernel.search_python_loop=0.05"]) == 1
+    capsys.readouterr()
+    # malformed specs are usage errors, not silent no-ops
+    assert gate.main(["--against", base, "--current", cur,
+                      "--metric-tolerance", "oops"]) == 2
+
+
 def test_gate_flattens_bench_wrapper(tmp_path):
     """The driver's BENCH_r*.json capture shape gates transparently."""
     wrapper = {"n": 5, "cmd": "python bench.py", "rc": 0, "tail": "...",

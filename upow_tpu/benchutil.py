@@ -60,6 +60,29 @@ def probe_platform(timeout: float = 90.0) -> Optional[str]:
     return "tpu" if value in TPU_PLATFORMS else value
 
 
+# Arm-provenance env contract, shared by bench.py and the loadgen
+# observatory: bench.py's scrubbed-env CPU child inherits WHY the
+# parent lost the chip through these, and any artifact writer can
+# stamp the same story without re-deriving it.
+ARM_FAILURE_ENV = "UPOW_BENCH_ARM_FAILURE"
+ARM_ATTEMPTED_ENV = "UPOW_BENCH_ATTEMPTED_BACKEND"
+ARM_ATTEMPT_ENV = "UPOW_BENCH_ARM_ATTEMPT"
+
+
+def arm_provenance_from_env(platform: Optional[str] = None) -> dict:
+    """The arm story the environment carries: what backend was
+    attempted (falling back to ``platform`` when unset), which arm
+    attempt produced this process (``runtime`` / ``cpu-child`` / ...),
+    and the failure reason when the attempt lost the chip."""
+    import os
+
+    return {
+        "attempted_backend": os.environ.get(ARM_ATTEMPTED_ENV, platform),
+        "arm_failure_reason": os.environ.get(ARM_FAILURE_ENV),
+        "arm_attempt": os.environ.get(ARM_ATTEMPT_ENV),
+    }
+
+
 _PROBE_CACHE: dict = {}
 
 
@@ -308,6 +331,122 @@ def leaf_spends(parents, addr, d, pub):
             out.append(Tx([TxInput(h, k)], [TxOutput(addr, o.amount)])
                        .sign([d], lambda _i: pub))
     return out
+
+
+def accept_resident_bench(seconds: float = 0.4, n_fan: int = 255,
+                          n_per: int = 32) -> dict:
+    """Config 15: end-to-end 8k-tx block accept, host-round-trip path
+    (per-table SQL membership) vs the HBM-resident fused accept path
+    (state/device_index.py probes fused into the digest-prep dispatch),
+    with the byte-identity differential — resident probe vs host shadow
+    map vs SQL — checked after accept, after a FORCED REORG
+    (remove_blocks), and after re-accepting the same block.  Shared by
+    bench_suite config 15 and the loadgen observatory so ``make
+    perf-smoke`` can enforce the same numbers.
+
+    The speedup fields are ZEROED unless every differential passed —
+    callers refuse to emit a headline from a diverged run."""
+    import asyncio
+
+    from .core import clock
+    from .verify import txverify
+
+    ABSENT = [("ff" * 32, i) for i in range(16)]
+
+    async def scenario(resident: bool) -> dict:
+        state, manager, d, pub, addr, mids, mine_block = \
+            await chain_with_utxo_fanout(n_fan, n_per, 0xACC7)
+        manager.fused_accept = resident
+        if resident:
+            state.enable_device_index()
+            if not state.resident_indexes():
+                raise RuntimeError("device UTXO index failed to arm")
+        txs = leaf_spends(mids, addr, d, pub)
+        spent = [i.outpoint for t in txs for i in t.inputs]
+        created = [(t.hash(), 0) for t in txs]
+        sample = spent + created + ABSENT
+        pre_hash = await state.get_unspent_outputs_hash()
+        txverify.clear_sig_verdicts()  # cold-signature accept, both paths
+        dt = await mine_block(txs)
+        out = {"n_txs": len(txs), "accept_seconds": dt,
+               "utxo_hash": await state.get_unspent_outputs_hash()}
+
+        async def parity() -> bool:
+            """Resident probe vs host shadow map vs SQL, one sample."""
+            idx = state.resident_indexes()["unspent_outputs"]
+            dev = [bool(v) for v in idx.contains_batch(sample)]
+            shadow = [bool(v) for v in idx.shadow_contains_batch(sample)]
+            sql = [bool(v) for v in
+                   await state.outpoints_exist(sample, "unspent_outputs")]
+            return dev == shadow == sql
+
+        # membership-scan micro-measure: the double-spend scan isolated
+        # from rules/sig work — the serial path's per-accept SQL
+        # round-trip vs one resident probe dispatch
+        t0 = time.perf_counter()
+        reps = 0
+        while time.perf_counter() - t0 < seconds or reps == 0:
+            if resident:
+                state.resident_indexes()["unspent_outputs"] \
+                    .contains_batch(sample)
+            else:
+                await state.outpoints_exist(sample, "unspent_outputs")
+            reps += 1
+        out["scan_tx_s"] = reps * len(sample) / (time.perf_counter() - t0)
+
+        if resident:
+            ok = await parity()
+            # forced reorg: drop the 8k block, O(delta) index rollback —
+            # the unspent-set fingerprint must return EXACTLY to its
+            # pre-accept value
+            await state.remove_blocks(4)
+            ok = ok and await parity()
+            ok = ok and pre_hash == await state.get_unspent_outputs_hash()
+            # re-accept the same transactions (the re-mined header gets
+            # a fresh timestamp, so its coinbase outpoint differs — the
+            # three-way parity is the byte-identity check here)
+            dt2 = await mine_block(txs)
+            ok = ok and await parity()
+            out["reaccept_seconds"] = dt2
+            out["reorg_ok"] = bool(ok)
+            stats = state.index_stats()
+            out["shadow_consults"] = stats["shadow_consults"]
+            out["twin_fingerprints"] = stats["twin_fingerprints"]
+        state.close()
+        return out
+
+    # both paths must see identical per-block timestamps or the block
+    # hashes (and therefore the coinbase outpoints) diverge and the
+    # hash differential is meaningless — the clock base is wall time,
+    # so a scenario crossing a wall-second boundary would skew the
+    # second run.  Freeze to a fixed epoch before EACH path; advance(60)
+    # per mined block still moves chain time on top of the frozen base.
+    clock.freeze(1_700_000_000)
+    serial = asyncio.run(scenario(False))
+    clock.freeze(1_700_000_000)
+    resident = asyncio.run(scenario(True))
+    clock.reset()
+
+    ok = bool(resident.get("reorg_ok")
+              and serial["utxo_hash"] == resident["utxo_hash"]
+              and serial["n_txs"] == resident["n_txs"])
+    speedup = serial["accept_seconds"] / resident["accept_seconds"]
+    scan_speedup = resident["scan_tx_s"] / serial["scan_tx_s"] \
+        if serial["scan_tx_s"] else 0.0
+    return {
+        "n_txs": serial["n_txs"],
+        "serial_tx_s": round(serial["n_txs"] / serial["accept_seconds"], 1),
+        "resident_tx_s": round(
+            resident["n_txs"] / resident["accept_seconds"], 1),
+        "speedup": round(speedup, 2) if ok else 0.0,
+        "scan_serial_tx_s": round(serial["scan_tx_s"], 1),
+        "scan_resident_tx_s": round(resident["scan_tx_s"], 1),
+        "scan_speedup": round(scan_speedup, 2) if ok else 0.0,
+        "differential_ok": ok,
+        "reaccept_seconds": round(resident["reaccept_seconds"], 4),
+        "shadow_consults": resident["shadow_consults"],
+        "twin_fingerprints": resident["twin_fingerprints"],
+    }
 
 
 def pipelined_loop(dispatch, finalize, seconds: float, depth: int = 2):

@@ -266,8 +266,24 @@ class DeviceRuntime:
                 else sk.pow_search_jnp
             return int(fn(template, spec, nonce_base=0, batch=256))
 
+        def warm_utxo_probe():
+            from ..state import device_index as di
+
+            # tiny throwaway index; _probe_eval is called directly (not
+            # through submit_call — this runs inside boxed_call off the
+            # drainer thread, and a nested submission would deadlock on
+            # the drainer blocked right here)
+            index = di.DeviceUtxoIndex(
+                [("ab" * 32, i) for i in range(4)],
+                values=[(i + 1, "warm", 0) for i in range(4)])
+            ops = [("ab" * 32, 0), ("cd" * 32, 9)]
+            present, _maybe, _amounts, _c = index._probe_eval(
+                ops, di.fingerprint_batch(ops), di.check_batch(ops))
+            return [bool(v) for v in present]
+
         for name, fn in (("p256_verify", warm_p256),
-                         ("sha256_search", warm_sha256)):
+                         ("sha256_search", warm_sha256),
+                         ("utxo_probe", warm_utxo_probe)):
             t0 = time.perf_counter()
             status, value = boxed_call(fn, timeout=left())
             entry = {"kernel": name, "status": status,

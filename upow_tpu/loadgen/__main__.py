@@ -41,7 +41,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--tolerance", type=float, default=None,
                     help="gate tolerance override")
     ap.add_argument("--report-only", action="store_true",
-                    help="gate reports but never fails the run")
+                    help="gate reports but never fails the run "
+                         "(except --enforce'd metrics)")
+    ap.add_argument("--metric-tolerance", action="append", default=[],
+                    metavar="NAME=TOL",
+                    help="per-metric gate tolerance (passed through)")
+    ap.add_argument("--enforce", action="append", default=[],
+                    metavar="SUBSTR",
+                    help="gate metrics matching SUBSTR even under "
+                         "--report-only (passed through)")
     args = ap.parse_args(argv)
 
     from .coresidency import CoresidencySpec
@@ -80,6 +88,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             gate_argv += ["--tolerance", str(args.tolerance)]
         if args.report_only:
             gate_argv.append("--report-only")
+        for spec_arg in args.metric_tolerance:
+            gate_argv += ["--metric-tolerance", spec_arg]
+        for substr in args.enforce:
+            gate_argv += ["--enforce", substr]
         return gate.main(gate_argv)
     return 0
 

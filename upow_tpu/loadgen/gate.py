@@ -20,6 +20,13 @@ regress downward — name inference is ambiguous for names like
 what the explicit override exists for.  A metric regresses when it is
 worse than baseline by more than ``--tolerance`` (relative).
 
+``--metric-tolerance NAME=TOL`` (repeatable) pins an exact flattened
+metric name to its own tolerance; ``--enforce SUBSTR`` (repeatable)
+promotes matching metrics from report-only to enforced — a regression
+on one fails the gate even under ``--report-only`` (how ``make
+perf-smoke`` keeps its advisory report while hard-gating the verify
+pipeline and resident accept kernels).
+
 Exit codes: 0 ok / report-only, 1 regression(s), 2 usage error.
 """
 
@@ -133,29 +140,36 @@ def load_metrics(path: str,
 
 def compare(baseline: Dict[str, float], current: Dict[str, float],
             tolerance: float,
-            directions: Optional[Dict[str, str]] = None) -> List[dict]:
+            directions: Optional[Dict[str, str]] = None,
+            metric_tolerances: Optional[Dict[str, float]] = None
+            ) -> List[dict]:
     """Per-common-metric verdicts, regressions first.  ``directions``
     carries the artifacts' explicit per-metric overrides; metrics
-    without one fall back to name inference."""
+    without one fall back to name inference.  ``metric_tolerances``
+    maps exact metric names to a tolerance that replaces the global one
+    for that metric (``--metric-tolerance NAME=TOL``)."""
     directions = directions or {}
+    metric_tolerances = metric_tolerances or {}
     rows = []
     for metric in sorted(set(baseline) & set(current)):
         base, cur = baseline[metric], current[metric]
+        tol = metric_tolerances.get(metric, tolerance)
         override = directions.get(metric)
         lower = (override == "lower") if override \
             else lower_is_better(metric)
         if base == 0:
-            regressed = lower and cur > 0 and tolerance < 1
+            regressed = lower and cur > 0 and tol < 1
             ratio = None
         else:
             ratio = cur / base
-            regressed = (ratio > 1 + tolerance if lower
-                         else ratio < 1 - tolerance)
+            regressed = (ratio > 1 + tol if lower
+                         else ratio < 1 - tol)
         rows.append({"metric": metric, "baseline": base, "current": cur,
                      "ratio": round(ratio, 4) if ratio is not None else None,
                      "direction": "lower" if lower else "higher",
                      "direction_source": "artifact" if override
                      else "inferred",
+                     "tolerance": tol,
                      "regressed": regressed})
     rows.sort(key=lambda r: (not r["regressed"], r["metric"]))
     return rows
@@ -174,8 +188,32 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="relative band before a worse value fails "
                          f"(default {DEFAULT_TOLERANCE})")
     ap.add_argument("--report-only", action="store_true",
-                    help="print verdicts but always exit 0")
+                    help="print verdicts but always exit 0 (except for "
+                         "--enforce'd metrics)")
+    ap.add_argument("--metric-tolerance", action="append", default=[],
+                    metavar="NAME=TOL",
+                    help="per-metric tolerance overriding --tolerance "
+                         "(exact flattened name, repeatable)")
+    ap.add_argument("--enforce", action="append", default=[],
+                    metavar="SUBSTR",
+                    help="metrics whose flattened name contains SUBSTR "
+                         "fail the gate even under --report-only "
+                         "(repeatable)")
     args = ap.parse_args(argv)
+
+    metric_tolerances: Dict[str, float] = {}
+    for spec in args.metric_tolerance:
+        name, sep, tol = spec.partition("=")
+        if not sep or not name:
+            print(f"gate: bad --metric-tolerance {spec!r} "
+                  "(want NAME=TOL)", file=sys.stderr)
+            return 2
+        try:
+            metric_tolerances[name] = float(tol)
+        except ValueError:
+            print(f"gate: bad --metric-tolerance value {tol!r}",
+                  file=sys.stderr)
+            return 2
 
     # direction overrides merge across both artifacts; the current one
     # wins (it carries the newest metadata for renamed/retyped metrics)
@@ -192,12 +230,18 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
 
-    rows = compare(baseline, current, args.tolerance, directions)
+    rows = compare(baseline, current, args.tolerance, directions,
+                   metric_tolerances)
     regressions = [r for r in rows if r["regressed"]]
+    enforced = [r for r in regressions
+                if any(s in r["metric"] for s in args.enforce)]
     report = {
         "against": args.against, "current": args.current,
         "tolerance": args.tolerance,
+        "metric_tolerances": metric_tolerances,
+        "enforce": args.enforce,
         "compared": len(rows), "regressions": len(regressions),
+        "enforced_regressions": len(enforced),
         "verdicts": rows,
     }
     print(json.dumps(report, indent=1, sort_keys=True))
@@ -205,11 +249,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("gate: WARNING no overlapping metrics between artifacts",
               file=sys.stderr)
         return 0
-    if regressions and not args.report_only:
-        for r in regressions:
+    failing = enforced if args.report_only else regressions
+    if failing:
+        for r in failing:
             print(f"gate: REGRESSION {r['metric']}: "
                   f"{r['baseline']} -> {r['current']} "
-                  f"({r['direction']} is better, tol {args.tolerance})",
+                  f"({r['direction']} is better, tol {r['tolerance']})"
+                  + (" [enforced]" if r in enforced else ""),
                   file=sys.stderr)
         return 1
     return 0

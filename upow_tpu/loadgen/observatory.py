@@ -18,9 +18,12 @@ structured JSON artifact:
   runtime, cross-source coalescing and fairness deltas with the same
   differential-gated mirroring into ``kernels``.
 * ``provenance`` — what actually ran: ``backend``, ``platform``,
-  ``attempted_backend``, ``arm_failure_reason``.  BENCH_r02–r05 all
-  silently degraded to a scrubbed-env CPU child; this block is the
-  machine-readable record that it happened (or didn't).
+  ``attempted_backend``, ``arm_failure_reason``, ``arm_attempt``
+  (which arm attempt produced this process — ``runtime`` /
+  ``cpu-child`` / ... — via bench.py's env contract in benchutil).
+  BENCH_r02–r05 all silently degraded to a scrubbed-env CPU child;
+  this block is the machine-readable record that it happened (or
+  didn't).
 * optionally appended (``--progress``) to PROGRESS.jsonl so the
   trajectory file carries SLO metrics alongside kernel throughput.
 
@@ -88,6 +91,27 @@ def kernel_bench(seconds: float = 0.4) -> dict:
             "unit": "x", "direction": "higher"}
     except Exception as e:
         log.warning("verify_pipeline bench skipped: %s", e)
+    try:
+        from ..benchutil import accept_resident_bench
+
+        # smoke-sized chain (the full 8k block belongs to bench_suite
+        # config 15); the differential contract is identical, and a
+        # divergence zeroes both speedups so the gate trips
+        ar = accept_resident_bench(seconds=min(seconds, 0.4),
+                                   n_fan=16, n_per=8)
+        out["accept_resident"] = {
+            "value": ar["resident_tx_s"], "unit": "tx/s",
+            "direction": "higher",
+            "differential_ok": ar["differential_ok"],
+            "shadow_consults": ar["shadow_consults"]}
+        out["accept_serial"] = {
+            "value": ar["serial_tx_s"], "unit": "tx/s",
+            "direction": "higher"}
+        out["accept_scan_speedup"] = {
+            "value": ar["scan_speedup"], "unit": "x",
+            "direction": "higher"}
+    except Exception as e:
+        log.warning("accept_resident bench skipped: %s", e)
     return out
 
 
@@ -153,9 +177,17 @@ def run_observatory(spec: Optional[PopulationSpec] = None,
 
     spec = spec or PopulationSpec()
     provenance = {"backend": "node-inprocess", "platform": "host",
-                  "attempted_backend": None, "arm_failure_reason": None}
+                  "attempted_backend": None, "arm_failure_reason": None,
+                  "arm_attempt": None}
     if device:
         provenance.update(_arm_device(probe_timeout))
+    # overlay the arm story bench.py's env contract carries (scrubbed
+    # CPU child, runtime re-arm, ...) — only the keys actually set, so
+    # a plain observatory run keeps its own probe-derived provenance
+    from ..benchutil import arm_provenance_from_env
+
+    provenance.update({k: v for k, v in
+                       arm_provenance_from_env().items() if v is not None})
 
     load = asyncio.run(run_against_node(spec))
     kernels = kernel_bench(bench_seconds)

@@ -727,12 +727,33 @@ class Node:
             e.counter("ws_dropped_messages", ws["dropped_messages"],
                       "Broadcast messages shed by per-subscriber bounded"
                       " send queues (drop-slowest policy)")
+            e.gauge("ws_send_queue_hwm", ws["send_queue_hwm"],
+                    "Deepest any subscriber send queue has ever been"
+                    " (high-watermark, including reaped connections)")
         for state_name, count in sorted(self.breakers.state_counts().items()):
             e.gauge(f"breaker_{state_name}_peers", count,
                     f"Peers whose circuit breaker is {state_name}")
         e.gauge("device_verify_health",
                 self.manager.device_health()["gauge"],
                 "Device verify path: 0=ok 1=degraded(CPU) 2=poisoned")
+        index_stats = getattr(self.state, "index_stats", lambda: None)()
+        if index_stats is not None:
+            e.gauge("utxo_index_entries", index_stats["entries"],
+                    "Live outpoints across the HBM-resident UTXO"
+                    " index tables")
+            e.gauge("utxo_index_resident_bytes",
+                    index_stats["resident_bytes"],
+                    "Device bytes held by the resident UTXO index")
+            e.gauge("utxo_index_twin_fingerprints",
+                    index_stats["twin_fingerprints"],
+                    "Fingerprints that ever held two live outpoints"
+                    " (forces shadow consult on hit)")
+            e.counter("utxo_index_probes", index_stats["probes"],
+                      "Resident-index membership probe dispatches")
+            e.counter("utxo_index_shadow_consults",
+                      index_stats["shadow_consults"],
+                      "Probes answered by the host shadow map"
+                      " (ambiguity; steady-state target is zero)")
         cache_entries = entry_count()
         if cache_entries >= 0:
             e.gauge("compile_cache_persistent_entries", cache_entries,

@@ -278,10 +278,13 @@ class PgChainState(StateViews):
     # ------------------------------------------------------ device index --
 
     def enable_device_index(self) -> None:
-        """Same device-resident membership prefilter as the sqlite
-        backend (storage.py enable_device_index).  Sync (blocking) —
-        called once at node boot; runtime resyncs go through
-        :meth:`_aindex_rebuild`."""
+        """Same device-resident membership index as the sqlite backend
+        (storage.py enable_device_index).  Sync (blocking) — called once
+        at node boot; runtime resyncs go through :meth:`_aindex_rebuild`.
+        The reference pg schema carries no amount column on
+        unspent_outputs, so bulk loads seed the resident value store
+        with zeros; incremental adds (which decode the tx) thread real
+        amounts.  Membership never depends on the value lanes."""
         if not self._device_index_usable():
             return
         from .device_index import DeviceUtxoIndex
@@ -304,15 +307,33 @@ class PgChainState(StateViews):
             return False
         return True
 
-    def _index_add(self, table, outpoints):
+    def _index_add(self, table, outpoints, values=None):
         if self._dev_index is not None:
             self._index_mutations += 1
-            self._dev_index[table].add(outpoints)
+            self._dev_index[table].add(outpoints, values)
 
     def _index_remove(self, table, outpoints):
         if self._dev_index is not None:
             self._index_mutations += 1
             self._dev_index[table].remove(outpoints)
+
+    def resident_indexes(self):
+        """Per-table DeviceUtxoIndex map when enabled, else None — the
+        accept path's gate for the fused resident probe."""
+        return self._dev_index
+
+    def index_stats(self):
+        """Aggregate resident-index telemetry (same shape as the sqlite
+        backend's); None when the index is disabled."""
+        if not self._dev_index:
+            return None
+        agg = {"entries": 0, "resident_bytes": 0, "probes": 0,
+               "shadow_consults": 0, "twin_fingerprints": 0}
+        for index in self._dev_index.values():
+            s = index.stats()
+            for k in agg:
+                agg[k] += s[k]
+        return agg
 
     async def _aindex_rebuild(self):
         """Resync the device index from the live tables without blocking
@@ -442,6 +463,21 @@ class PgChainState(StateViews):
                 await self.drv.aexecutemany(
                     f"DELETE FROM {table} WHERE tx_hash = $1",
                     [(h,) for h in created])
+            # O(delta) index maintenance (ISSUE 11): delta-remove the
+            # removed txs' outputs by class (absent = no-op), mirroring
+            # the sqlite backend; restores delta-add below, so the
+            # wholesale post-reorg resync is gone from the happy path.
+            # The _open_txn rollback rebuild still covers failures.
+            if self._dev_index is not None:
+                doomed_by_table: Dict[str, list] = {}
+                for tx in txs:
+                    h = tx.hash()
+                    for index, out in enumerate(tx.outputs):
+                        doomed_by_table.setdefault(
+                            _OUTPUT_TABLE[out.output_type], []).append(
+                                (h, index))
+                for table, outpoints in doomed_by_table.items():
+                    self._index_remove(table, outpoints)
             created_set = set(created)
             restore = [
                 tx_input for tx in txs if not tx.is_coinbase
@@ -455,14 +491,6 @@ class PgChainState(StateViews):
             await self.drv.aexecute(
                 "DELETE FROM blocks WHERE id >= $1", (from_block_id,))
             self._bump_fees_gen()
-        # wholesale resync (restores don't update the index per row);
-        # under the writer lock so a concurrent accept committing between
-        # our fetches and the swap can't be clobbered by a stale snapshot
-        # (skip when this task owns an outer transaction — it already
-        # holds the non-reentrant lock and resyncs after its own exit)
-        if not self._owns_txn():
-            async with self._writer():
-                await self._aindex_rebuild()
         if self.on_blocks_removed is not None:
             self.on_blocks_removed(from_block_id)
 
@@ -490,6 +518,10 @@ class PgChainState(StateViews):
                     f'INSERT INTO {table} (tx_hash, "index", address)'
                     " VALUES ($1,$2,$3)",
                     (tx_input.tx_hash, tx_input.index, out.address))
+            # delta-add: the existence check above already filtered
+            # duplicate restores, so the index stays in lockstep
+            self._index_add(table, [(tx_input.tx_hash, tx_input.index)],
+                            values=[(out.amount, out.address or "", 0)])
 
     # ------------------------------------------------------- transactions --
 
@@ -793,7 +825,9 @@ class PgChainState(StateViews):
                         f'INSERT INTO {table} (tx_hash, "index", address)'
                         " VALUES ($1,$2,$3)",
                         [(h, i, o.address) for h, i, o in entries])
-                self._index_add(table, [(h, i) for h, i, _ in entries])
+                self._index_add(table, [(h, i) for h, i, _ in entries],
+                                values=[(o.amount, o.address or "", 0)
+                                        for _h, _i, o in entries])
 
     async def remove_outputs(self, txs: Sequence[AnyTx]) -> None:
         """Spend inputs from the table their tx type targets

@@ -281,13 +281,15 @@ class ChainState(StateViews):
         self._dev_index = {}
         for table in ("unspent_outputs",) + _GOV_TABLES:
             rows = self.db.execute(
-                f"SELECT tx_hash, idx FROM {table}").fetchall()
+                f"SELECT tx_hash, idx, amount, address FROM {table}"
+            ).fetchall()
             self._dev_index[table] = DeviceUtxoIndex(
-                (r["tx_hash"], r["idx"]) for r in rows)
+                [(r["tx_hash"], r["idx"]) for r in rows],
+                values=[(r["amount"], r["address"] or "", 0) for r in rows])
 
-    def _index_add(self, table: str, outpoints) -> None:
+    def _index_add(self, table: str, outpoints, values=None) -> None:
         if self._dev_index is not None:
-            self._dev_index[table].add(outpoints)
+            self._dev_index[table].add(outpoints, values)
 
     def _index_remove(self, table: str, outpoints) -> None:
         if self._dev_index is not None:
@@ -296,6 +298,26 @@ class ChainState(StateViews):
     def _index_rebuild(self) -> None:
         if self._dev_index is not None:
             self.enable_device_index()
+
+    def resident_indexes(self) -> Optional[Dict[str, object]]:
+        """The per-table :class:`DeviceUtxoIndex` map when the device
+        index is enabled and armed, else None — the accept path's gate
+        for the fused resident probe (verify/block.py)."""
+        return self._dev_index
+
+    def index_stats(self) -> Optional[dict]:
+        """Aggregate resident-index telemetry across every UTXO-class
+        table (residency bytes, probe/shadow-consult counters) for the
+        /metrics exporter; None when the index is disabled."""
+        if not self._dev_index:
+            return None
+        agg = {"entries": 0, "resident_bytes": 0, "probes": 0,
+               "shadow_consults": 0, "twin_fingerprints": 0}
+        for index in self._dev_index.values():
+            s = index.stats()
+            for k in agg:
+                agg[k] += s[k]
+        return agg
 
     def close(self):
         self.db.close()
@@ -443,6 +465,20 @@ class ChainState(StateViews):
             self.db.executemany(
                 f"DELETE FROM {table} WHERE tx_hash = ?", [(h,) for h in created]
             )
+        # O(delta) index maintenance (ISSUE 11): enumerate the removed
+        # txs' outputs by class and delta-remove them — already-spent
+        # outputs are absent and no-op, matching the blanket SQL DELETE.
+        # The restored spends below delta-add through the same hooks, so
+        # the full rebuild a reorg used to pay is gone.
+        if self._dev_index is not None:
+            doomed_by_table: Dict[str, list] = {}
+            for tx in txs:
+                h = tx.hash()
+                for index, out in enumerate(tx.outputs):
+                    doomed_by_table.setdefault(
+                        _OUTPUT_TABLE[out.output_type], []).append((h, index))
+            for table, outpoints in doomed_by_table.items():
+                self._index_remove(table, outpoints)
         # restore outputs their inputs had spent — but not outputs of txs
         # that are themselves being removed (reference database.py
         # remove_blocks filters `tx_input.tx_hash not in transactions_hashes`;
@@ -474,7 +510,6 @@ class ChainState(StateViews):
         self._bump_fees_gen()
         self._pending_gen += 1
         self._commit()
-        self._index_rebuild()  # reorgs are rare; a bulk resync is ms
         if self.on_blocks_removed is not None:
             self.on_blocks_removed(from_block_id)
 
@@ -511,7 +546,11 @@ class ChainState(StateViews):
         return True
 
     async def _restore_spent_outputs(self, inputs: List[TxInput]) -> None:
-        """Re-materialize spent outputs by decoding their source txs."""
+        """Re-materialize spent outputs by decoding their source txs.
+        Index delta-adds are gated on the INSERT actually landing
+        (OR IGNORE may hit an existing row, e.g. a whitelisted
+        historical double-spend restoring one outpoint twice) so the
+        resident index never drifts a duplicate ahead of the table."""
         for tx_input in inputs:
             src = await self.get_transaction(tx_input.tx_hash, include_pending=False)
             if src is None:
@@ -519,18 +558,21 @@ class ChainState(StateViews):
             out = src.outputs[tx_input.index]
             table = _OUTPUT_TABLE[out.output_type]
             if table == "unspent_outputs":
-                self.db.execute(
+                cur = self.db.execute(
                     "INSERT OR IGNORE INTO unspent_outputs (tx_hash, idx, address,"
                     " amount, is_stake) VALUES (?,?,?,?,?)",
                     (tx_input.tx_hash, tx_input.index, out.address, out.amount,
                      int(out.is_stake)),
                 )
             else:
-                self.db.execute(
+                cur = self.db.execute(
                     f"INSERT OR IGNORE INTO {table} (tx_hash, idx, address, amount)"
                     " VALUES (?,?,?,?)",
                     (tx_input.tx_hash, tx_input.index, out.address, out.amount),
                 )
+            if cur.rowcount > 0:
+                self._index_add(table, [(tx_input.tx_hash, tx_input.index)],
+                                values=[(out.amount, out.address or "", 0)])
 
     # ------------------------------------------------------- transactions --
 
@@ -851,7 +893,9 @@ class ChainState(StateViews):
                     " amount) VALUES (?,?,?,?)",
                     [(h, i, o.address, o.amount) for h, i, o in entries],
                 )
-            self._index_add(table, [(h, i) for h, i, _ in entries])
+            self._index_add(table, [(h, i) for h, i, _ in entries],
+                            values=[(o.amount, o.address or "", 0)
+                                    for _h, _i, o in entries])
 
     async def remove_outputs(self, txs: Sequence[AnyTx]) -> None:
         """Spend inputs from the table their tx type targets

@@ -60,7 +60,8 @@ def configure(cfg=None) -> None:
     device.preregister("p256_verify")
     device.preregister("sha256_txid")
     device.preregister_runtime()
-    for stage in ("block_decode", "block_sig_wait"):
+    device.preregister_index()
+    for stage in ("block_decode", "block_sig_wait", "accept_probe"):
         device.preregister_stage(stage)
     # shared sig dispatch front (verify/dispatch.py) — deferred import:
     # telemetry must stay importable without the verify package

@@ -149,6 +149,35 @@ def record_runtime_dispatch(n_submissions: int,
                  seconds=seconds)
 
 
+INDEX_KERNELS = ("utxo_probe", "utxo_apply", "accept_fused")
+
+
+def preregister_index() -> None:
+    """Create the HBM-resident UTXO index families (state/device_index.py)
+    so /metrics exports them before the first probe: the probe/apply/
+    fused kernel series plus the probe counters — ``shadow_consults``
+    is the accept path's zero-host-round-trip acceptance signal (it
+    stays 0 on collision-free blocks)."""
+    for kernel in INDEX_KERNELS:
+        preregister(kernel)
+    for c in ("probes", "probe_outpoints", "shadow_consults",
+              "ambiguous_probes"):
+        metrics.ensure_counter("index.%s" % c)
+
+
+def record_index_probe(outpoints: int, shadow_consults: int,
+                       ambiguous: int = 0) -> None:
+    """Record one resident-index probe batch: how many outpoints it
+    answered, and how many needed the host shadow map (fingerprint
+    ambiguity — the steady-state target is zero)."""
+    metrics.inc("index.probes")
+    metrics.inc("index.probe_outpoints", max(int(outpoints), 0))
+    if shadow_consults:
+        metrics.inc("index.shadow_consults", int(shadow_consults))
+    if ambiguous:
+        metrics.inc("index.ambiguous_probes", int(ambiguous))
+
+
 def record_cost(kernel: str, analysis: dict) -> None:
     """Store an XLA ``compiled.cost_analysis()`` estimate for ``kernel``
     (``upow_tpu/profiling``): numeric entries only, keys sanitized to

@@ -78,7 +78,8 @@ MERKLE_EXCEPTION = (
 
 def _fused_digest_prep(transactions: Sequence[Tx],
                        txid_backend: str = "host",
-                       txid_min_batch: int = 256) -> Dict[int, tuple]:
+                       txid_min_batch: int = 256,
+                       probe: Optional[Sequence[tuple]] = None):
     """Fused sha256 preparation for one verify micro-batch.
 
     Per tx, THREE digests feed the hot path: the raw signing-bytes
@@ -96,8 +97,19 @@ def _fused_digest_prep(transactions: Sequence[Tx],
     ``txid_min_batch``, hashes inline with hashlib — fusing only pays
     where a device dispatch is amortized.
 
+    ``probe`` (HBM-resident accept path) is a list of
+    ``(DeviceUtxoIndex, outpoints)`` parts: the micro-batch's outpoint
+    membership probes ride the SAME runtime dispatch as the device
+    txid batch via :func:`state.device_index.fused_probe` — one
+    scheduler slot for digest prep + membership instead of two queue
+    round-trips.  With a probe, the device txid batch additionally
+    requires the degrade gate (``txverify.device_verify_allowed``) so
+    a degraded device path falls back to hashlib without abandoning
+    the probe dispatch.
+
     Returns ``{id(tx): (digest, digest_hexform)}`` for
-    ``collect_sig_checks``.
+    ``collect_sig_checks``; with ``probe``, returns
+    ``(that dict, [(present, amounts, shadow_consults), ...])``.
     """
     payloads: List[bytes] = []
     need_txid: List[bool] = []
@@ -109,7 +121,22 @@ def _fused_digest_prep(transactions: Sequence[Tx],
         need_txid.append(need)
         if need:
             payloads.append(bytes.fromhex(tx.hex()))
-    if txid_backend == "host" or len(payloads) < txid_min_batch:
+    probe_results = None
+    if probe is not None:
+        from ..state.device_index import fused_probe
+        from ..crypto.sha256 import txid_batch
+        from .txverify import device_verify_allowed
+
+        extra = None
+        if (txid_backend != "host" and len(payloads) >= txid_min_batch
+                and device_verify_allowed()):
+            extra = functools.partial(txid_batch, payloads,
+                                      backend=txid_backend)
+        probe_results, digests = fused_probe(probe, extra_fn=extra,
+                                             source="block")
+        if digests is None:
+            digests = [hashlib.sha256(p).hexdigest() for p in payloads]
+    elif txid_backend == "host" or len(payloads) < txid_min_batch:
         digests = [hashlib.sha256(p).hexdigest() for p in payloads]
     else:
         from ..crypto.sha256 import txid_batch
@@ -125,6 +152,8 @@ def _fused_digest_prep(transactions: Sequence[Tx],
             tx._hash = digests[pos]
             pos += 1
         out[id(tx)] = pair
+    if probe is not None:
+        return out, probe_results
     return out
 
 
@@ -138,7 +167,8 @@ class BlockManager:
                  verify_mesh_devices: int = 1,
                  verify_microbatch: int = 1024,
                  txid_backend: str = "host",
-                 txid_min_batch: int = 256):
+                 txid_min_batch: int = 256,
+                 fused_accept: bool = True):
         self.state = state
         self.sig_backend = sig_backend
         self.verify_pad_block = verify_pad_block
@@ -152,6 +182,11 @@ class BlockManager:
         self.verify_microbatch = verify_microbatch
         self.txid_backend = txid_backend
         self.txid_min_batch = txid_min_batch
+        # HBM-resident accept path: when the state exposes armed
+        # DeviceUtxoIndex tables (state.resident_indexes()), fuse the
+        # per-micro-batch membership probe into the digest-prep dispatch
+        # and skip the per-table SQL round-trips entirely
+        self.fused_accept = fused_accept
         self._difficulty_cache: Optional[Tuple[Decimal, dict]] = None
         self._inode_cache: Optional[List[dict]] = None
         self._inode_cache_time = 0.0  # monotonic epoch, not consensus  # upowlint: disable=CP001
@@ -282,8 +317,25 @@ class BlockManager:
             errors.append("block is too big")
             return False
 
+        # double-spend scan: the fused resident path answers membership
+        # from the HBM-resident UTXO index inside the SAME dispatch as
+        # the digest prep (zero per-tx host round-trips in steady state)
+        # and hands the prepared digests forward; otherwise the serial
+        # per-table SQL scan runs first, exactly as before.  Both paths
+        # feed the identical verdict (whitelist, dup detect, error
+        # strings), so acceptance is byte-identical.
+        prep_cache: Optional[Dict[int, tuple]] = None
         if transactions:
-            if not await self._check_block_double_spends(
+            resident = None
+            if self.fused_accept and hasattr(self.state, "resident_indexes"):
+                resident = self.state.resident_indexes()
+            if resident:
+                prep_cache, by_table, presence = \
+                    await self._fused_accept_scan(transactions, resident)
+                if not self._double_spend_verdict(
+                        by_table, presence, block_no, errors):
+                    return False
+            elif not await self._check_block_double_spends(
                     transactions, block_no, errors):
                 return False
 
@@ -311,9 +363,14 @@ class BlockManager:
         for start in range(0, len(transactions), mb):
             chunk = transactions[start:start + mb]
             t0 = time.perf_counter()
-            prep = await loop.run_in_executor(None, functools.partial(
-                _fused_digest_prep, chunk, self.txid_backend,
-                self.txid_min_batch))
+            if prep_cache is not None:
+                # fused accept path already hashed the whole block during
+                # the membership scan — phase 2 is pure rules + sig
+                prep = prep_cache
+            else:
+                prep = await loop.run_in_executor(None, functools.partial(
+                    _fused_digest_prep, chunk, self.txid_backend,
+                    self.txid_min_batch))
             chunk_checks: List[tuple] = []
             for tx in chunk:
                 if not await verifier.rules_ok(tx, check_double_spend=False):
@@ -372,16 +429,25 @@ class BlockManager:
             return False
         return True
 
-    async def _check_block_double_spends(self, transactions: Sequence[Tx],
-                                         block_no: int, errors: list) -> bool:
-        """Per-class outpoint set-diff vs the six UTXO tables
-        (manager.py:469-615), with the historical whitelist."""
+    @staticmethod
+    def _inputs_by_table(transactions: Sequence[Tx]) -> dict:
+        """Group every input outpoint by the UTXO-class table it spends
+        from, in tx order (reference database.py:589-622 partitioning)."""
         by_table: dict = {}
         for tx in transactions:
             table = _INPUT_TABLE.get(tx.transaction_type, "unspent_outputs")
             by_table.setdefault(table, []).extend(i.outpoint for i in tx.inputs)
+        return by_table
+
+    @staticmethod
+    def _double_spend_verdict(by_table: dict, presence: dict,
+                              block_no: int, errors: list) -> bool:
+        """Shared verdict over per-table membership flags: missing set,
+        in-block duplicate detect, and the historical whitelist — error
+        strings identical on the SQL and fused resident paths
+        (manager.py:469-615)."""
         for table, outpoints in by_table.items():
-            present = await self.state.outpoints_exist(outpoints, table)
+            present = presence[table]
             missing = {o for o, ok in zip(outpoints, present) if not ok}
             has_dup = len(set(outpoints)) != len(outpoints)
             if not missing and not has_dup:
@@ -393,6 +459,65 @@ class BlockManager:
             errors.append(f"double spend in block: {block_no} ({table})")
             return False
         return True
+
+    async def _check_block_double_spends(self, transactions: Sequence[Tx],
+                                         block_no: int, errors: list) -> bool:
+        """Per-class outpoint set-diff vs the six UTXO tables
+        (manager.py:469-615), with the historical whitelist."""
+        by_table = self._inputs_by_table(transactions)
+        presence = {
+            table: await self.state.outpoints_exist(outpoints, table)
+            for table, outpoints in by_table.items()}
+        return self._double_spend_verdict(by_table, presence, block_no, errors)
+
+    async def _fused_accept_scan(self, transactions: Sequence[Tx],
+                                 resident: dict) -> tuple:
+        """Phase 1 of the HBM-resident accept path: walk the block in
+        verify micro-batches and, per batch, run ONE fused runtime
+        dispatch doing sha256 digest prep + resident outpoint membership
+        (:func:`_fused_digest_prep` with ``probe=``).  Membership for a
+        table without a resident index (never the case after
+        ``enable_device_index``, but cheap to keep correct) falls back
+        to the SQL scan.
+
+        Returns ``(prep_cache, by_table, presence)``: the whole block's
+        digest dict for phase 2, plus per-table outpoints and presence
+        flags in the same grouping/order the serial scan produces."""
+        loop = asyncio.get_event_loop()
+        mb = self.verify_microbatch or len(transactions) or 1
+        prep_cache: Dict[int, tuple] = {}
+        by_table: dict = {}
+        presence: dict = {}
+        host_tables: dict = {}
+        n_probed = 0
+        t0 = time.perf_counter()
+        for start in range(0, len(transactions), mb):
+            chunk = transactions[start:start + mb]
+            chunk_tables = self._inputs_by_table(chunk)
+            parts = [(table, ops) for table, ops in chunk_tables.items()
+                     if ops and table in resident]
+            prep, probe_results = await loop.run_in_executor(
+                None, functools.partial(
+                    _fused_digest_prep, chunk, self.txid_backend,
+                    self.txid_min_batch,
+                    probe=[(resident[t], ops) for t, ops in parts]))
+            prep_cache.update(prep)
+            for (table, ops), (present, _amounts, _consults) in zip(
+                    parts, probe_results):
+                by_table.setdefault(table, []).extend(ops)
+                presence.setdefault(table, []).extend(
+                    bool(p) for p in present)
+                n_probed += len(ops)
+            for table, ops in chunk_tables.items():
+                if ops and table not in resident:
+                    host_tables.setdefault(table, []).extend(ops)
+        for table, ops in host_tables.items():
+            by_table.setdefault(table, []).extend(ops)
+            presence.setdefault(table, []).extend(
+                await self.state.outpoints_exist(ops, table))
+        ktel.record_stage("accept_probe", time.perf_counter() - t0,
+                          items=n_probed)
+        return prep_cache, by_table, presence
 
     # ------------------------------------------------------ create_block --
 

@@ -114,6 +114,15 @@ def _device_usable() -> bool:
     return platform not in (None, "cpu")
 
 
+def device_verify_allowed() -> bool:
+    """Public gate for other verify-path device dispatches (fused accept
+    path, txid batching): a device backend is up AND the degrade state
+    machine currently allows dispatching to it.  Mirrors exactly the
+    check ``_resolve_backend`` applies before routing signature batches
+    to the device."""
+    return _device_usable() and DEGRADE.allow()
+
+
 async def run_sig_checks_async(checks: Sequence[tuple],
                                backend: str = "auto",
                                pad_block: int = 128,

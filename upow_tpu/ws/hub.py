@@ -42,6 +42,9 @@ from ..config import WsConfig
 from ..logger import get_logger
 
 log = get_logger("ws")
+from ..logger import get_logger
+
+log = get_logger("ws")
 
 # broadcast encoder, module-level so tests can swap in a counting
 # wrapper: broadcast_to_channel serializes each message through this
@@ -75,6 +78,7 @@ class WsConnection:
         self.bytes_in = 0
         self.bytes_out = 0
         self.dropped = 0            # messages shed by queue overflow
+        self.queue_hwm = 0          # deepest the send queue ever got
         self._bucket_times: list = []
         # 0 = unbounded (never shed); the deque IS the queue, the event
         # signals the writer — a plain asyncio.Queue cannot drop-oldest
@@ -102,7 +106,19 @@ class WsConnection:
         if self._queue.maxlen and len(self._queue) == self._queue.maxlen:
             self._queue.popleft()  # deque would do this silently; count it
             self.dropped += 1
+            try:
+                from ..telemetry import event as _event
+
+                # WHICH subscriber is shedding (and how badly) was
+                # invisible on /metrics — the counter is hub-global
+                _event("ws_queue_evict", subscriber=self.id, ip=self.ip,
+                       dropped_total=self.dropped,
+                       queue_len=len(self._queue) + 1)
+            except Exception:  # telemetry must never break delivery
+                log.debug("ws_queue_evict event failed", exc_info=True)
         self._queue.append(message)
+        if len(self._queue) > self.queue_hwm:
+            self.queue_hwm = len(self._queue)
         self._queue_event.set()
         return True
 
@@ -155,6 +171,7 @@ class WsHub:
         self.connects_total = 0
         self.disconnects_total = 0
         self.dropped_total = 0  # includes shed counts of reaped conns
+        self.queue_hwm_total = 0  # deepest any queue got, ever (incl. reaped)
 
     # ------------------------------------------------------------ endpoint --
     async def handle(self, request: web.Request) -> web.WebSocketResponse:
@@ -273,6 +290,7 @@ class WsHub:
             # finally both drop the same connection
             self.disconnects_total += 1
             self.dropped_total += conn.dropped
+            self.queue_hwm_total = max(self.queue_hwm_total, conn.queue_hwm)
         conn._closed = True
         writer = self._writers.pop(conn.id, None)
         if writer is not None:
@@ -366,6 +384,9 @@ class WsHub:
             "disconnects_total": self.disconnects_total,
             "dropped_messages": self.dropped_total + sum(
                 c.dropped for c in self.connections.values()),
+            "send_queue_hwm": max(
+                [self.queue_hwm_total]
+                + [c.queue_hwm for c in self.connections.values()]),
         }
 
     def get_detailed_stats(self) -> dict:
@@ -380,6 +401,8 @@ class WsHub:
                     "messages_out": c.messages_out,
                     "bytes_in": c.bytes_in,
                     "bytes_out": c.bytes_out,
+                    "dropped": c.dropped,
+                    "queue_hwm": c.queue_hwm,
                 }
                 for c in self.connections.values()
             ],
