@@ -53,23 +53,30 @@ swarm:
 # metadata — is registered with gate.py on each smoke run.  The
 # readpath and coresidency headlines zero themselves (tripping the
 # gate) if their byte differentials ever diverge.
-# Report-only overall, but the verify-pipeline and resident-accept
-# kernels are ENFORCED (ISSUE 11): a differential divergence zeroes
-# those headline values, so the enforced gate also catches correctness
-# breaks, not just slowdowns.  Per-metric tolerances are wider than the
-# global band because smoke-sized runs on shared CI hosts are noisy.
+# Report-only overall, but the verify-pipeline, resident-accept and
+# mesh-mining kernels are ENFORCED (ISSUES 11, 12): a differential
+# divergence zeroes those headline values, so the enforced gate also
+# catches correctness breaks, not just slowdowns.  Per-metric
+# tolerances are wider than the global band because smoke-sized runs
+# on shared CI hosts are noisy.  mine_mesh_speedup is a ratio of two
+# short measurements (widest band); its correctness trip is the
+# differential zeroing, which defeats any tolerance.
 perf-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.loadgen --smoke \
 		--out observatory-smoke.json \
 		--against observatory.json --report-only \
 		--enforce kernel.verify_pipeline \
 		--enforce kernel.accept_ \
+		--enforce kernel.mine_mesh \
 		--metric-tolerance kernel.verify_pipeline=0.60 \
 		--metric-tolerance kernel.verify_pipeline_serial=0.60 \
 		--metric-tolerance kernel.verify_pipeline_speedup=0.60 \
 		--metric-tolerance kernel.accept_resident=0.60 \
 		--metric-tolerance kernel.accept_serial=0.60 \
-		--metric-tolerance kernel.accept_scan_speedup=0.60
+		--metric-tolerance kernel.accept_scan_speedup=0.60 \
+		--metric-tolerance kernel.mine_mesh_sharded=0.60 \
+		--metric-tolerance kernel.mine_mesh_serial=0.60 \
+		--metric-tolerance kernel.mine_mesh_speedup=0.45
 
 # Device-runtime gate (docs/DEVICE_RUNTIME.md): the fairness /
 # coalescing / degrade-flip / arm-failure test matrix, then the DR

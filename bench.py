@@ -112,7 +112,25 @@ def _attach_last_good(result: dict) -> dict:
 from upow_tpu.benchutil import (ARM_ATTEMPT_ENV as _ARM_ATTEMPT_ENV,
                                 ARM_ATTEMPTED_ENV as _ARM_ATTEMPTED_ENV,
                                 ARM_FAILURE_ENV as _ARM_FAILURE_ENV,
+                                ARM_LADDER_ENV as _ARM_LADDER_ENV,
                                 arm_provenance_from_env)
+
+
+def _merge_env_ladder(attempts: list) -> list:
+    """Append per-attempt arm records to the env-carried ladder (the
+    scrubbed CPU child inherits the parent's rungs this way) and return
+    the merged list — the one arm story every emitted line and
+    .bench_events.jsonl record carries."""
+    prior = []
+    raw = os.environ.get(_ARM_LADDER_ENV)
+    if raw:
+        try:
+            prior = json.loads(raw)
+        except ValueError:
+            prior = [{"attempt": "unparsed", "error": raw}]
+    merged = prior + list(attempts)
+    os.environ[_ARM_LADDER_ENV] = json.dumps(merged)
+    return merged
 
 # Same file/format as tpu_watch.py's event log, so the watcher's
 # timeline and the bench's own arm story interleave in one place.
@@ -177,17 +195,28 @@ def _reexec_cpu_child(reason: str) -> int:
 def _init_jax_backend(retries: int = 2, delay: float = 5.0,
                       probe_timeout: float = 90.0):
     """Initialize a JAX backend, surviving flaky TPU tunnels (see
-    upow_tpu.benchutil.probe_platform).  Returns the platform string, or
-    None when the caller should re-exec the scrubbed CPU child."""
-    from upow_tpu.benchutil import probe_platform
+    upow_tpu.benchutil.probe_platform_detail).  Returns
+    ``(platform_or_None, attempts)`` — each attempt record carries the
+    probe's ACTUAL exception text and traceback fingerprint, not a bare
+    "hung/failed"; None platform means re-exec the scrubbed CPU child."""
+    from upow_tpu.benchutil import probe_platform_detail
 
+    attempts = []
     for attempt in range(retries):
-        platform = probe_platform(probe_timeout)
-        if platform is not None:
-            return platform
-        sys.stderr.write(f"backend init attempt {attempt + 1} hung/failed\n")
+        d = probe_platform_detail(probe_timeout)
+        attempts.append({
+            "attempt": "probe-%d" % (attempt + 1),
+            "ok": d["platform"] is not None,
+            "seconds": d["seconds"], "error": d["error"],
+            "traceback_fingerprint": d["traceback_fingerprint"],
+        })
+        if d["platform"] is not None:
+            return d["platform"], attempts
+        sys.stderr.write(
+            "backend init attempt %d failed: %s\n" % (attempt + 1,
+                                                      d["error"]))
         time.sleep(delay)
-    return None
+    return None, attempts
 
 
 def _baseline_python_mhs(prefix: bytes, seconds: float = 1.0) -> float:
@@ -360,7 +389,13 @@ def main() -> int:
 
     if os.environ.get(_CPU_CHILD_MARKER):
         os.environ.setdefault(_ARM_ATTEMPT_ENV, "cpu-child")
-        platform = _init_jax_backend()
+        platform, attempts = _init_jax_backend()
+        # prefix each rung with the env attempt name so the merged
+        # ladder reads runtime -> runtime-scrubbed-env -> cpu-child
+        who = os.environ.get(_ARM_ATTEMPT_ENV, "cpu-child")
+        for rec in attempts:
+            rec["attempt"] = "%s-%s" % (who, rec["attempt"])
+        _merge_env_ladder(attempts)
     else:
         # Arm through the device-runtime service (the one sanctioned
         # dispatch issuer).  Attempt 1: normal arm.  Attempt 2: in-process
@@ -373,6 +408,12 @@ def main() -> int:
         os.environ[_ARM_ATTEMPT_ENV] = "runtime"
         info = get_runtime().arm(attempt="runtime")
         platform = info.get("platform")
+        _merge_env_ladder([{
+            "attempt": "runtime", "ok": platform is not None,
+            "seconds": info.get("probe_seconds"),
+            "error": info.get("arm_failure_reason"),
+            "traceback_fingerprint": info.get("traceback_fingerprint"),
+        }])
         if platform is None:
             reason = (info.get("arm_failure_reason")
                       or "backend probe hung/failed")
@@ -384,6 +425,13 @@ def main() -> int:
             info = get_runtime().arm(scrub_env=True, force=True,
                                      attempt="runtime-scrubbed-env")
             platform = info.get("platform")
+            _merge_env_ladder([{
+                "attempt": "runtime-scrubbed-env",
+                "ok": platform is not None,
+                "seconds": info.get("probe_seconds"),
+                "error": info.get("arm_failure_reason"),
+                "traceback_fingerprint": info.get("traceback_fingerprint"),
+            }])
             if platform is not None:
                 # the scrub pins JAX_PLATFORMS=cpu, so this attempt can
                 # only land on cpu — record why attempt 1 lost the chip
@@ -398,7 +446,8 @@ def main() -> int:
     _record_bench_event(
         "bench_arm", attempt=os.environ.get(_ARM_ATTEMPT_ENV, "runtime"),
         platform=platform or "none",
-        reason=os.environ.get(_ARM_FAILURE_ENV))
+        reason=os.environ.get(_ARM_FAILURE_ENV),
+        arm_ladder=_merge_env_ladder([]))
     if platform is None:
         if os.environ.get(_CPU_CHILD_MARKER):
             # even the clean CPU child failed: emit the honest zero line

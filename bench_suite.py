@@ -27,6 +27,10 @@ configs, one JSON line each.
     path vs the HBM-resident fused accept (device probe + digest prep
     in one dispatch), byte-identity differential incl. forced reorg +
     re-accept built in
+16. mining_mesh: resident mesh-sharded nonce search (one compiled SPMD
+    program, job fields as runtime data) vs the serial single-device
+    path — bit-identity differential over seeded jobs built in, plus
+    per-shard-count hashrate rows
 
 ``bench.py`` stays the driver-facing single-line headline (sha256
 search + the verify sub-metric); this suite is the full scoreboard.
@@ -657,6 +661,34 @@ def config15_accept_resident(seconds: float):
           None, direction="lower")
 
 
+def config16_mining_mesh(seconds: float):
+    """Resident mesh-sharded nonce search (ISSUE 12 acceptance): one
+    compiled SPMD program across the dp mesh, every job field a traced
+    argument (a chain-tip change never recompiles), dispatched through
+    the device runtime under source "mine".  The bit-identity
+    differential — mesh min-hit == serial jnp min-hit per window over
+    >= 3 seeded jobs, plus disjoint shard coverage from the engine's
+    own accounting — must hold or the sharded headline and the speedup
+    are zeroed (the gate trips on correctness, not just slowdowns)."""
+    from upow_tpu.benchutil import mining_mesh_bench
+
+    batch = (1 << 22) if _platform() == "tpu" else (1 << 14)
+    r = mining_mesh_bench(seconds=min(seconds / 2, 4.0),
+                          batch_per_device=batch,
+                          shard_counts=(1, 2, 4, 8))
+    assert r["differential_ok"], \
+        "mesh search diverged from the serial path"
+    _emit(f"mine_mesh_sharded_{r['n_devices']}x_{_platform()}",
+          r["sharded_mhs"], "MH/s", r["serial_mhs"], direction="higher")
+    _emit(f"mine_mesh_serial_{_platform()}", r["serial_mhs"], "MH/s",
+          None, direction="higher")
+    _emit(f"mine_mesh_speedup_{_platform()}", r["speedup"], "x", None,
+          direction="higher")
+    for row in r["per_shard_counts"]:
+        _emit(f"mine_mesh_{row['shards']}shard_{_platform()}",
+              row["mhs"], "MH/s", None, direction="higher")
+
+
 def config9_sync(seconds: float):
     """End-to-end chain sync over real localhost HTTP: node B downloads
     node A's chain in pages (prefetch pipeline, page-level signature
@@ -799,8 +831,9 @@ def main() -> int:
         "13": lambda: config13_readpath_cache(args.seconds),
         "14": lambda: config14_coresidency(args.seconds),
         "15": lambda: config15_accept_resident(args.seconds),
+        "16": lambda: config16_mining_mesh(args.seconds),
     }
-    needs_device = {"2", "3", "5", "7"}
+    needs_device = {"2", "3", "5", "7", "16"}
     failed = []
     for key in args.configs.split(","):
         key = key.strip()

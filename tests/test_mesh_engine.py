@@ -1,0 +1,294 @@
+"""Resident mesh-sharded nonce search (ISSUE 12, mine/mesh_engine.py).
+
+Acceptance coverage on the virtual 8-device CPU mesh (conftest.py):
+differential bit-identity over >= 3 seeded jobs vs the serial jnp path,
+disjoint/exact per-round shard coverage straight from the engine's own
+dispatch accounting, the no-recompile job swap (compile-cache counters
+plus jax's jit cache size), single-dispatch-owner routing through the
+device runtime under source "mine", and the structured arm ladder with
+real exception text.
+"""
+
+import random
+
+import jax
+import pytest
+
+from upow_tpu import telemetry
+from upow_tpu.crypto import SENTINEL, make_template, pow_search_jnp, target_spec
+from upow_tpu.mine import mesh_engine
+from upow_tpu.mine.engine import MiningJob, mine
+from upow_tpu.mine.mesh_engine import (MeshEngine, get_mesh_engine,
+                                       reset_mesh_engine)
+from upow_tpu.telemetry import metrics
+
+rng = random.Random(0xA11CE)
+
+
+def _rand_bytes(n):
+    return bytes(rng.randrange(256) for _ in range(n))
+
+
+def _seeded_job(seed: int, difficulty="1.5") -> MiningJob:
+    r = random.Random(seed)
+    prefix = bytes(r.randrange(256) for _ in range(104))
+    prev_hash = bytes(r.randrange(256) for _ in range(32)).hex()
+    from decimal import Decimal
+
+    return MiningJob(prefix, prev_hash, Decimal(difficulty))
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    telemetry.reset()
+    telemetry.configure()
+    reset_mesh_engine()
+    yield
+    reset_mesh_engine()
+    telemetry.reset()
+    telemetry.configure()
+
+
+def _armed_engine(batch_per_device=1024) -> MeshEngine:
+    eng = get_mesh_engine(batch_per_device=batch_per_device)
+    info = eng.arm()
+    assert info["armed"], info
+    assert eng.n_devices == 8  # the virtual CPU mesh
+    return eng
+
+
+# ------------------------------------------------- differential identity ----
+
+def test_differential_bit_identity_three_seeded_jobs():
+    """>= 3 seeded jobs: every mesh round returns EXACTLY the serial
+    path's min-hit for the same window — not merely "a" valid nonce."""
+    eng = _armed_engine(batch_per_device=1024)
+    total = eng.capacity  # 8 * 1024
+    for seed in (101, 202, 303, 404):
+        job = _seeded_job(seed)
+        eng.set_job(job)
+        template = make_template(job.prefix)
+        spec = target_spec(job.previous_hash, job.difficulty)
+        for start in (0, 1 << 20):
+            got = int(eng.dispatch(start, total))
+            want = int(pow_search_jnp(template, spec, nonce_base=start,
+                                      batch=total))
+            assert got == want, (seed, start)
+            if got != int(SENTINEL):
+                assert job.check(got)
+
+
+def test_partial_and_tiny_rounds_match_serial():
+    """Tail rounds (count < capacity, even count < n_devices) mask the
+    idle lanes instead of scanning them — empty shards included."""
+    eng = _armed_engine(batch_per_device=512)
+    job = _seeded_job(7, difficulty="1")
+    eng.set_job(job)
+    template = make_template(job.prefix)
+    spec = target_spec(job.previous_hash, job.difficulty)
+    for start, count in ((0, 3), (1 << 16, 100), (5, eng.capacity - 1)):
+        got = int(eng.dispatch(start, count))
+        want = int(pow_search_jnp(template, spec, nonce_base=start,
+                                  batch=count))
+        assert got == want, (start, count)
+
+
+def test_mine_mesh_backend_matches_jnp_backend():
+    """The full mine() loop through backend='mesh' finds the same nonce
+    as backend='jnp' with identical round boundaries."""
+    job = _seeded_job(55, difficulty="1")
+    kw = dict(start=0, stride_end=1 << 14, batch=1 << 12, ttl=60.0)
+    want = mine(job, backend="jnp", **kw)
+    got = mine(job, backend="mesh", **kw)
+    assert got.nonce == want.nonce
+    assert got.hashes_tried == want.hashes_tried
+
+
+# ------------------------------------------------ disjoint coverage ----
+
+def test_dispatch_accounting_proves_disjoint_exact_coverage():
+    """The union of per-shard ranges across rounds equals the scanned
+    window exactly — no overlap, no gap, straight from stats()."""
+    eng = _armed_engine(batch_per_device=512)
+    eng.set_job(_seeded_job(9, difficulty="1"))
+    start, total, rounds = 1000, eng.capacity * 3 + 17, 0
+    cursor = start
+    while cursor < start + total:
+        count = min(eng.capacity, start + total - cursor)
+        eng.dispatch(cursor, count)
+        cursor += count
+        rounds += 1
+
+    st = eng.stats()
+    assert st["dispatches"] == rounds
+    assert st["nonces_planned"] == total
+    covered = []
+    for rec in st["rounds"]:
+        shards = rec["shards"]
+        # within a round: adjacent, disjoint, exactly [lo, hi)
+        assert shards[0][0] == rec["lo"] and shards[-1][1] == rec["hi"]
+        for (a, b), (c, d) in zip(shards, shards[1:]):
+            assert b == c
+        covered.extend([s for s in shards if s[0] < s[1]])
+    covered.sort()
+    # across rounds: the non-empty shard ranges tile [start, start+total)
+    assert covered[0][0] == start and covered[-1][1] == start + total
+    for (a, b), (c, d) in zip(covered, covered[1:]):
+        assert b == c
+    assert sum(b - a for a, b in covered) == total
+
+
+def test_dispatch_rejects_oversized_round():
+    eng = _armed_engine(batch_per_device=64)
+    eng.set_job(_seeded_job(3))
+    with pytest.raises(ValueError):
+        eng.dispatch(0, eng.capacity + 1)
+    with pytest.raises(ValueError):
+        eng.dispatch(0, 0)
+
+
+# ---------------------------------------------- no-recompile job swap ----
+
+def test_job_swap_is_pure_dispatch_no_recompile():
+    """A new job / chain-tip change must NOT recompile the resident
+    program: jax's jit cache size stays flat and the mine_mesh
+    compile-cache counters record one miss then only hits."""
+    from upow_tpu.parallel import mesh as pmesh
+
+    eng = _armed_engine(batch_per_device=256)
+    eng.set_job(_seeded_job(1))
+    eng.dispatch(0, eng.capacity)
+    jit_entries = pmesh._pow_search_mesh_resident._cache_size()
+    misses0 = metrics.counters().get(
+        "kernel.mine_mesh.compile_cache_misses", 0)
+    assert misses0 == 1  # the first dispatch's key
+
+    for seed in (2, 3, 4):  # three job swaps, different targets too
+        eng.set_job(_seeded_job(seed, difficulty=str(1 + seed / 10)))
+        eng.dispatch(seed * 1000, eng.capacity)
+
+    assert pmesh._pow_search_mesh_resident._cache_size() == jit_entries
+    counters = metrics.counters()
+    assert counters.get("kernel.mine_mesh.compile_cache_misses", 0) == misses0
+    assert counters.get("kernel.mine_mesh.compile_cache_hits", 0) >= 3
+
+
+def test_engine_reuse_and_replacement_semantics():
+    """get_mesh_engine: armed engine is reused while the round fits its
+    capacity; a larger round replaces it (one deliberate recompile)."""
+    eng = _armed_engine(batch_per_device=128)
+    assert get_mesh_engine(round_hint=eng.capacity) is eng
+    assert get_mesh_engine(round_hint=eng.capacity // 2) is eng
+    bigger = get_mesh_engine(round_hint=eng.capacity * 2)
+    assert bigger is not eng
+
+
+# ------------------------------------------- single dispatch owner ----
+
+def test_all_mesh_dispatches_ride_the_runtime_as_mine():
+    """Every warm + round dispatch shows up in the device runtime's
+    per-source accounting under "mine" — no side-channel dispatches."""
+    from upow_tpu.device.runtime import get_runtime
+
+    runtime = get_runtime()
+    before = runtime.stats()["per_source"].get("mine", 0)
+    eng = _armed_engine(batch_per_device=64)  # warm rides source "mine"
+    eng.set_job(_seeded_job(42))
+    n = 3
+    for i in range(n):
+        eng.dispatch(i * eng.capacity, eng.capacity)
+    after = runtime.stats()["per_source"].get("mine", 0)
+    assert after - before == n + 1  # n rounds + the arm-time warm
+
+
+# ------------------------------------------------------- arm ladder ----
+
+def test_arm_ladder_captures_real_exception_text(monkeypatch):
+    """Both in-process rungs fail with a real exception: the ladder
+    records its text + traceback fingerprint per attempt, and the
+    engine's failure reason strings them together (no "hung/failed")."""
+    from upow_tpu.device import runtime as rt_mod
+
+    class WedgedRuntime:
+        def arm(self, **kw):
+            raise RuntimeError(
+                "PJRT INTERNAL: tunnel wedged behind another client")
+
+        def platform(self):
+            return None
+
+        def stats(self):
+            return {"arm": {}}
+
+    monkeypatch.setattr(rt_mod, "get_runtime", lambda: WedgedRuntime())
+    monkeypatch.setattr(
+        mesh_engine, "_child_probe",
+        lambda timeout=0: {"attempt": "child-probe", "ok": False,
+                           "seconds": 0.01,
+                           "error": "child probe rc=1; stderr tail: "
+                                    "RuntimeError: no backend"})
+    eng = MeshEngine()
+    info = eng.arm(timeout=1.0)
+    assert not info["armed"]
+    ladder = info["ladder"]
+    assert [r["attempt"] for r in ladder] == [
+        "runtime", "runtime-scrubbed-env", "child-probe"]
+    for rung in ladder[:2]:
+        assert not rung["ok"]
+        assert "tunnel wedged" in rung["error"]
+        assert rung["traceback_fingerprint"]
+    reason = eng.arm_failure_reason
+    assert "runtime: " in reason and "child-probe: " in reason
+    assert "tunnel wedged" in reason and "no backend" in reason
+    # the dispatcher path surfaces the same reason, verbatim
+    with pytest.raises(RuntimeError, match="tunnel wedged"):
+        eng.dispatcher(_seeded_job(1))
+
+
+def test_arm_ladder_success_records_platform_rung():
+    eng = _armed_engine()
+    assert eng.arm_failure_reason is None
+    ladder = eng.arm_ladder
+    assert ladder and ladder[-1]["ok"]
+    assert "cpu x8" in ladder[-1]["detail"]
+    # re-arming is a no-op that returns the same ladder
+    again = eng.arm()
+    assert again["armed"] and again["ladder"] == ladder
+
+
+def test_warm_hook_arms_engine_without_submit_call():
+    """The runtime AOT hook path (direct call, no nested submit) leaves
+    a dispatch-ready engine behind."""
+    mesh_engine.warm_resident_search()
+    eng = get_mesh_engine()
+    assert eng.armed and eng.n_devices == 8
+    eng.set_job(_seeded_job(77, difficulty="1"))
+    template = make_template(_seeded_job(77, difficulty="1").prefix)
+    spec = target_spec(eng._job_key[1], "1")
+    got = int(eng.dispatch(0, eng.capacity))
+    want = int(pow_search_jnp(template, spec, nonce_base=0,
+                              batch=eng.capacity))
+    assert got == want
+
+
+# ------------------------------------------------------- telemetry ----
+
+def test_mine_round_telemetry_families():
+    eng = _armed_engine(batch_per_device=128)
+    eng.set_job(_seeded_job(5, difficulty="1"))
+    eng.dispatch(0, eng.capacity // 2)  # half occupancy
+    eng.note_hit()
+    counters = metrics.counters()
+    assert counters.get("kernel.mine_mesh.lanes_real", 0) == eng.capacity // 2
+    assert counters.get("kernel.mine_mesh.lanes_padded", 0) == eng.capacity
+    hists = metrics.histograms()
+    assert hists["mine.shard_occupancy"]["count"] == eng.n_devices
+    assert hists["mine.hit_latency"]["count"] == 1
+
+
+def test_engine_stats_exported_for_node_gauges():
+    assert mesh_engine.engine_stats() is None  # before first use
+    eng = _armed_engine(batch_per_device=64)
+    st = mesh_engine.engine_stats()
+    assert st["armed"] and st["devices"] == 8
+    assert st["capacity"] == eng.capacity

@@ -56,6 +56,65 @@ def test_shard_bounds_partition():
         assert b == c
 
 
+@pytest.mark.parametrize("lo,hi,count", [
+    (0, 1 << 32, 8),          # full nonce space
+    (0, 3, 8),                # span < count: some shards MUST be empty
+    (17, 17, 4),              # zero span: every shard empty
+    (0, 1, 1),                # single nonce, single shard
+    ((1 << 64) - 5, 1 << 64, 3),   # 2^64-adjacent bounds (python ints)
+    ((1 << 64) - 1, 1 << 64, 8),   # one nonce at the very top
+    (123456789, 123456789 + 7919, 13),  # prime span, odd shard count
+])
+def test_shard_bounds_properties(lo, hi, count):
+    """Disjointness + exact coverage + monotonicity for every shard
+    count, including the adversarial shapes (span < count, zero span,
+    2^64-adjacent) a mesh tail round can hand the planner."""
+    parts = [shard_bounds(lo, hi, i, count) for i in range(count)]
+    # exact coverage: first starts at lo, last ends at hi, no gaps
+    assert parts[0][0] == lo and parts[-1][1] == hi
+    for (a, b), (c, d) in zip(parts, parts[1:]):
+        assert b == c  # adjacent => disjoint AND gapless
+    # monotone, never inverted, and sizes differ by at most one
+    sizes = []
+    for a, b in parts:
+        assert lo <= a <= b <= hi
+        sizes.append(b - a)
+    assert sum(sizes) == hi - lo
+    if sizes:
+        assert max(sizes) - min(sizes) <= 1
+
+
+def test_shard_bounds_monotone_in_index():
+    """Shard start is non-decreasing in the shard index — a permuted
+    device order can never produce overlapping ranges."""
+    starts = [shard_bounds(1000, 1000 + 997, i, 16)[0] for i in range(16)]
+    assert starts == sorted(starts)
+
+
+def test_multihost_plan_deterministic_across_orderings():
+    """Every process computes the SAME full plan no matter in which
+    order it asks for the rows — the contract that makes uncoordinated
+    multi-host range claims safe."""
+    from upow_tpu.parallel.multihost import plan_nonce_ranges
+
+    for k in (2, 5, 8):
+        baseline = plan_nonce_ranges(k)
+        order = list(range(k))
+        rng.shuffle(order)
+        # recompute the plan fresh per shuffled index and compare rows
+        for i in order:
+            assert plan_nonce_ranges(k)[i] == baseline[i]
+        assert plan_nonce_ranges(k) == baseline  # fully repeatable
+
+
+def test_multihost_plan_rejects_bad_ranges():
+    from upow_tpu.parallel.multihost import NONCE_SPACE, plan_nonce_ranges
+
+    for lo, hi in ((5, 5), (10, 4), (-1, 10), (0, NONCE_SPACE + 1)):
+        with pytest.raises(AssertionError):
+            plan_nonce_ranges(2, lo, hi)
+
+
 def test_verify_batch_sharded_matches_unsharded():
     """The verify program is elementwise over batch: sharded in == same out."""
     from upow_tpu.core import curve

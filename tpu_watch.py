@@ -115,6 +115,19 @@ def _run_grouped(argv, deadline: float, log_name: str) -> int:
             return -9
 
 
+def _stderr_evidence(err: str) -> dict:
+    """Collapse a probe child's stderr into event fields: the last few
+    lines (the actual exception text) plus a stable fingerprint so
+    recurring failures group in post-hoc triage."""
+    tail = "\n".join((err or "").strip().splitlines()[-6:])
+    if not tail:
+        return {}
+    from upow_tpu.benchutil import text_fingerprint
+
+    return {"stderr_tail": tail[-800:],
+            "traceback_fingerprint": text_fingerprint(tail)}
+
+
 def _probe() -> bool:
     """True iff a fresh subprocess sees a non-cpu jax backend in time.
 
@@ -125,19 +138,19 @@ def _probe() -> bool:
             "print('PLATFORM=' + jax.devices()[0].platform, flush=True)\n")
     proc = subprocess.Popen(
         [sys.executable, "-c", code], stdout=subprocess.PIPE,
-        stderr=subprocess.DEVNULL, text=True, start_new_session=True)
+        stderr=subprocess.PIPE, text=True, start_new_session=True)
     try:
-        out, _ = proc.communicate(timeout=_PROBE_TIMEOUT)
+        out, err = proc.communicate(timeout=_PROBE_TIMEOUT)
     except subprocess.TimeoutExpired:
         try:
             os.killpg(proc.pid, signal.SIGKILL)
         except OSError:
             pass
-        proc.communicate()
+        _, err = proc.communicate()
         _log(f"probe: timeout after {_PROBE_TIMEOUT:.0f}s (tunnel wedged)")
         _record_event("bench_arm_failed", attempted_backend="tpu",
                       reason=f"probe timeout after {_PROBE_TIMEOUT:.0f}s",
-                      source="tpu_watch")
+                      source="tpu_watch", **_stderr_evidence(err))
         return False
     for line in (out or "").splitlines():
         if line.startswith("PLATFORM="):
@@ -146,13 +159,13 @@ def _probe() -> bool:
             if plat in ("cpu",):
                 _record_event("bench_arm_failed", attempted_backend="tpu",
                               reason="only cpu visible to jax",
-                              source="tpu_watch")
+                              source="tpu_watch", **_stderr_evidence(err))
                 return False
             return True
     _log(f"probe: no platform line (rc={proc.returncode})")
     _record_event("bench_arm_failed", attempted_backend="tpu",
                   reason=f"no platform line (rc={proc.returncode})",
-                  source="tpu_watch")
+                  source="tpu_watch", **_stderr_evidence(err))
     return False
 
 

@@ -44,20 +44,62 @@ def boxed_call(fn, timeout: float):
 TPU_PLATFORMS = ("tpu", "axon")
 
 
-def probe_platform(timeout: float = 90.0) -> Optional[str]:
-    """Platform string of jax.devices()[0]; None if init hung or failed.
-    TPU-class platform aliases (axon tunnel) normalize to "tpu" so every
-    downstream backend-routing comparison sees one canonical name."""
+def text_fingerprint(text: str) -> str:
+    """Short stable hash of diagnostic text (stderr tails, frame lists)
+    so repeated arm failures can be grouped without comparing full
+    tracebacks."""
+    return hashlib.sha256(text.encode("utf-8", "replace")).hexdigest()[:12]
+
+
+def traceback_fingerprint(exc: BaseException) -> str:
+    """Fingerprint of an exception's traceback SHAPE (file:function per
+    frame, no line numbers or message text): two arm attempts that died
+    on the same code path share a fingerprint even when addresses or
+    timeouts in the message differ."""
+    import traceback as _tb
+
+    frames = _tb.extract_tb(exc.__traceback__) if exc.__traceback__ else []
+    sig = "|".join("%s:%s" % (f.filename.rsplit("/", 1)[-1], f.name)
+                   for f in frames[-8:])
+    return text_fingerprint("%s|%s" % (type(exc).__name__, sig))
+
+
+def probe_platform_detail(timeout: float = 90.0) -> dict:
+    """Backend probe that KEEPS the failure: returns
+    ``{status, platform, seconds, error, traceback_fingerprint}`` where
+    ``status`` is the boxed_call outcome ("ok" / "err" / "timeout"),
+    ``platform`` is the normalized name (None unless ok), and ``error``
+    is the actual exception text — the thing every "hung/failed" log
+    line used to throw away."""
     import jax
 
     # module-global boxed_call on purpose: tests monkeypatch it to fake
     # probe outcomes; jax.devices() here IS the probe the runtime arms
     # through, not a stray dispatch
+    t0 = time.perf_counter()
     status, value = boxed_call(  # upowlint: disable=DR002
         lambda: jax.devices()[0].platform, timeout)  # upowlint: disable=DR001
-    if status != "ok":
-        return None
-    return "tpu" if value in TPU_PLATFORMS else value
+    detail = {"status": status, "platform": None,
+              "seconds": round(time.perf_counter() - t0, 3),
+              "error": None, "traceback_fingerprint": None}
+    if status == "ok":
+        detail["platform"] = "tpu" if value in TPU_PLATFORMS else value
+    elif status == "timeout":
+        detail["error"] = ("backend init still inside jax.devices() after "
+                           "%.0fs (native hang; no Python exception to "
+                           "show)" % timeout)
+    else:  # "err": value IS the exception boxed_call caught
+        detail["error"] = repr(value)
+        if isinstance(value, BaseException):
+            detail["traceback_fingerprint"] = traceback_fingerprint(value)
+    return detail
+
+
+def probe_platform(timeout: float = 90.0) -> Optional[str]:
+    """Platform string of jax.devices()[0]; None if init hung or failed.
+    TPU-class platform aliases (axon tunnel) normalize to "tpu" so every
+    downstream backend-routing comparison sees one canonical name."""
+    return probe_platform_detail(timeout)["platform"]
 
 
 # Arm-provenance env contract, shared by bench.py and the loadgen
@@ -67,23 +109,44 @@ def probe_platform(timeout: float = 90.0) -> Optional[str]:
 ARM_FAILURE_ENV = "UPOW_BENCH_ARM_FAILURE"
 ARM_ATTEMPTED_ENV = "UPOW_BENCH_ATTEMPTED_BACKEND"
 ARM_ATTEMPT_ENV = "UPOW_BENCH_ARM_ATTEMPT"
+ARM_LADDER_ENV = "UPOW_BENCH_ARM_LADDER"
 
 
 def arm_provenance_from_env(platform: Optional[str] = None) -> dict:
     """The arm story the environment carries: what backend was
     attempted (falling back to ``platform`` when unset), which arm
     attempt produced this process (``runtime`` / ``cpu-child`` / ...),
-    and the failure reason when the attempt lost the chip."""
+    the failure reason when the attempt lost the chip, and the full
+    per-attempt ladder (JSON list with each rung's real exception text
+    and traceback fingerprint) when the parent recorded one."""
+    import json
     import os
 
-    return {
+    out = {
         "attempted_backend": os.environ.get(ARM_ATTEMPTED_ENV, platform),
         "arm_failure_reason": os.environ.get(ARM_FAILURE_ENV),
         "arm_attempt": os.environ.get(ARM_ATTEMPT_ENV),
     }
+    raw = os.environ.get(ARM_LADDER_ENV)
+    if raw:
+        try:
+            out["arm_ladder"] = json.loads(raw)
+        except ValueError:
+            out["arm_ladder"] = [{"attempt": "unparsed", "error": raw}]
+    return out
 
 
 _PROBE_CACHE: dict = {}
+
+
+def probe_detail_cached(timeout: float = 90.0) -> dict:
+    """One probe per process (see :func:`probed_platform_cached`), but
+    returning the full :func:`probe_platform_detail` record so callers
+    can surface the real failure text instead of a bare None."""
+    if "detail" not in _PROBE_CACHE:
+        _PROBE_CACHE["detail"] = probe_platform_detail(timeout)
+        _PROBE_CACHE["platform"] = _PROBE_CACHE["detail"]["platform"]
+    return _PROBE_CACHE["detail"]
 
 
 def probed_platform_cached(timeout: float = 90.0) -> Optional[str]:
@@ -92,7 +155,7 @@ def probed_platform_cached(timeout: float = 90.0) -> Optional[str]:
     bench) — so a hung backend costs the process ONE timeout, not one
     per subsystem."""
     if "platform" not in _PROBE_CACHE:
-        _PROBE_CACHE["platform"] = probe_platform(timeout)
+        _PROBE_CACHE["platform"] = probe_detail_cached(timeout)["platform"]
     return _PROBE_CACHE["platform"]
 
 
@@ -446,6 +509,113 @@ def accept_resident_bench(seconds: float = 0.4, n_fan: int = 255,
         "reaccept_seconds": round(resident["reaccept_seconds"], 4),
         "shadow_consults": resident["shadow_consults"],
         "twin_fingerprints": resident["twin_fingerprints"],
+    }
+
+
+def mining_mesh_bench(seconds: float = 0.4, n_jobs: int = 3,
+                      batch_per_device: int = 1 << 12,
+                      shard_counts=()) -> dict:
+    """Config 16: resident mesh-sharded nonce search (mine/mesh_engine)
+    vs the serial single-device jnp path, with the bit-identity
+    differential built in: over ``n_jobs`` seeded jobs every mesh round
+    must return EXACTLY the serial path's min-hit for the same window
+    (full rounds AND a ragged tail round), and the engine's own dispatch
+    accounting must show disjoint, gapless shard coverage.  Shared by
+    bench_suite config 16 and the loadgen observatory so ``make
+    perf-smoke`` enforces the same numbers.
+
+    The sharded headline and the speedup are ZEROED unless every
+    differential check passed — a diverged run trips the gate instead of
+    reporting a fast wrong number.  ``shard_counts`` adds per-mesh-size
+    hashrate rows (each extra size is one extra compile; the observatory
+    smoke passes none)."""
+    import random as _random
+    from decimal import Decimal
+
+    from .crypto import sha256 as sk
+    from .mine.engine import MiningJob
+    from .mine.mesh_engine import MeshEngine
+
+    def seeded_job(seed: int) -> MiningJob:
+        r = _random.Random(seed)
+        prefix = bytes(r.randrange(256) for _ in range(104))
+        prev = bytes(r.randrange(256) for _ in range(32)).hex()
+        # difficulty 3: a hit lands roughly once per 4k nonces, so the
+        # differential windows mix hits (at varying shards) and misses
+        return MiningJob(prefix, prev, Decimal("3.0"))
+
+    engine = MeshEngine(batch_per_device=batch_per_device)
+    if not engine.arm()["armed"]:
+        raise RuntimeError("mesh engine failed to arm: "
+                           + (engine.arm_failure_reason or "unknown"))
+    cap = engine.capacity
+
+    ok, checks = True, 0
+    template = spec = job = None
+    for i in range(n_jobs):
+        job = seeded_job(0xD1F0 + i)
+        engine.set_job(job)
+        template = sk.make_template(job.prefix)
+        spec = sk.target_spec(job.previous_hash, job.difficulty)
+        for start, count in ((0, cap), (1 << 20, cap),
+                             (1 << 24, cap // 3 + 1)):
+            got = int(engine.dispatch(start, count))
+            want = int(sk.pow_search_jnp(template, spec,
+                                         nonce_base=start, batch=count))
+            ok = ok and got == want
+            if got != int(sk.SENTINEL):
+                ok = ok and job.check(got)
+            checks += 1
+    for rec in engine.stats()["rounds"]:
+        shards = rec["shards"]
+        ok = ok and shards[0][0] == rec["lo"] \
+            and shards[-1][1] == rec["hi"] \
+            and all(b == c for (_, b), (c, _) in zip(shards, shards[1:]))
+        checks += 1
+
+    def rate_of(dispatch_round, round_size) -> float:
+        cursor = [0]
+
+        def dispatch():
+            r = dispatch_round(cursor[0], round_size)
+            cursor[0] = (cursor[0] + round_size) % (1 << 31)
+            return r
+
+        int(dispatch())  # warm outside the timed window
+        rounds, elapsed = pipelined_loop(dispatch, lambda r: int(r),
+                                         seconds)
+        return rounds * round_size / elapsed / 1e6
+
+    sharded_mhs = rate_of(engine.dispatch, cap)
+    serial_mhs = rate_of(
+        lambda start, count: sk.pow_search_jnp(
+            template, spec, nonce_base=start, batch=count), cap)
+
+    rows = []
+    for n in shard_counts:
+        if not 1 <= n <= engine.n_devices:
+            continue
+        if n == engine.n_devices:
+            rows.append({"shards": n, "mhs": round(sharded_mhs, 3)})
+            continue
+        sub = MeshEngine(mesh_devices=n,
+                         batch_per_device=batch_per_device)
+        if not sub.arm()["armed"]:
+            continue
+        sub.set_job(job)
+        rows.append({"shards": n,
+                     "mhs": round(rate_of(sub.dispatch, sub.capacity), 3)})
+
+    speedup = sharded_mhs / serial_mhs if serial_mhs else 0.0
+    return {
+        "n_devices": engine.n_devices,
+        "batch_per_device": engine.batch_per_device,
+        "differential_ok": ok,
+        "differential_checks": checks,
+        "serial_mhs": round(serial_mhs, 3),
+        "sharded_mhs": round(sharded_mhs, 3) if ok else 0.0,
+        "speedup": round(speedup, 2) if ok else 0.0,
+        "per_shard_counts": rows,
     }
 
 

@@ -287,6 +287,38 @@ def _hit_nonce(digest, nonces, mask0, val0, mask1, val1, spec: TargetSpec):
     return jnp.min(jnp.where(ok, nonces, jnp.uint32(SENTINEL)))
 
 
+def pack_target(spec: TargetSpec) -> np.ndarray:
+    """Pack a :class:`TargetSpec` into the (7,) u32 vector consumed by
+    :func:`_hit_nonce_dynamic` — [mask0, val0, mask1, val1, nibble_word,
+    nibble_shift, charset].  Every field rides as runtime data, so the
+    resident mesh program re-dispatches on a new chain tip / difficulty
+    without recompiling."""
+    return np.array(
+        [spec.mask0, spec.val0, spec.mask1, spec.val1,
+         spec.nibble_word, spec.nibble_shift, spec.charset],
+        dtype=np.uint32,
+    )
+
+
+def _hit_nonce_dynamic(digest, nonces, target, valid=None):
+    """Data-dependent twin of :func:`_hit_nonce` for the resident mesh
+    search program: the Python-static ``charset < 16`` branch and the
+    static digest-word index become traced ops so the whole target is a
+    dynamic argument (see :func:`pack_target`).  ``valid`` masks lanes
+    beyond the shard's planned range on tail rounds."""
+    ok = (digest[0] & target[0]) == target[1]
+    ok &= (digest[1] & target[2]) == target[3]
+    # nibble_word = k // 8 for k <= 16 hex chars, so only words 0..2 can
+    # ever hold the fractional nibble; charset == 16 disables the check.
+    word = jnp.take(jnp.stack([digest[0], digest[1], digest[2]]),
+                    target[4].astype(jnp.int32), axis=0)
+    nib = (word >> target[5]) & jnp.uint32(0xF)
+    ok &= (target[6] >= jnp.uint32(16)) | (nib < target[6])
+    if valid is not None:
+        ok &= valid
+    return jnp.min(jnp.where(ok, nonces, jnp.uint32(SENTINEL)))
+
+
 @functools.partial(jax.jit, static_argnames=("batch", "nonce_spec", "spec"))
 def _pow_search_jnp(midstate, tail_words, nonce_base, batch: int,
                     nonce_spec, spec: TargetSpec):

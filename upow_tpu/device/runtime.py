@@ -199,14 +199,22 @@ class DeviceRuntime:
             info.update(platform=platform, attempt=attempt,
                         probe_seconds=round(elapsed, 3), armed=True)
             if platform is None:
-                info["arm_failure_reason"] = (
-                    "backend init attempt hung/failed within %.0fs"
-                    % timeout)
+                # carry the probe's ACTUAL failure text when the cached
+                # detail record has one (exception repr or explicit-hang
+                # note) instead of only the generic "hung/failed"
+                detail = benchutil._PROBE_CACHE.get("detail") or {}
+                info["arm_failure_reason"] = detail.get("error") or (
+                    "backend probe hung/failed within %.0fs" % timeout)
+                info["probe_status"] = detail.get("status", "no-platform")
+                info["traceback_fingerprint"] = \
+                    detail.get("traceback_fingerprint")
                 log.warning("device runtime armed WITHOUT a backend "
                             "(%s); all sources served on host paths",
                             info["arm_failure_reason"])
             else:
                 info["arm_failure_reason"] = None
+                info.pop("probe_status", None)
+                info.pop("traceback_fingerprint", None)
             # platform is known: unblock platform()/devices() callers
             # before the (potentially long) AOT warm below
             self._arm_done.set()
@@ -281,8 +289,18 @@ class DeviceRuntime:
                 ops, di.fingerprint_batch(ops), di.check_batch(ops))
             return [bool(v) for v in present]
 
+        def warm_mesh_search():
+            # resident mesh program (mine/mesh_engine.py) — multi-device
+            # only; like warm_utxo_probe this is a DIRECT call (a nested
+            # submit_call would deadlock the drainer blocked right here)
+            from ..mine.mesh_engine import warm_resident_search
+
+            warm_resident_search()
+            return True
+
         for name, fn in (("p256_verify", warm_p256),
                          ("sha256_search", warm_sha256),
+                         ("sha256_search_mesh", warm_mesh_search),
                          ("utxo_probe", warm_utxo_probe)):
             t0 = time.perf_counter()
             status, value = boxed_call(fn, timeout=left())

@@ -112,6 +112,28 @@ def kernel_bench(seconds: float = 0.4) -> dict:
             "direction": "higher"}
     except Exception as e:
         log.warning("accept_resident bench skipped: %s", e)
+    try:
+        from ..benchutil import mining_mesh_bench
+
+        # smoke-sized rounds on whatever mesh is visible (one device on
+        # a plain CPU host; the 8-shard case is CI's mesh job).  A
+        # diverged differential zeroes the sharded headline and the
+        # speedup so the enforced gate trips on correctness breaks.
+        mm = mining_mesh_bench(seconds=min(seconds, 0.4),
+                               batch_per_device=1 << 12)
+        out["mine_mesh_sharded"] = {
+            "value": mm["sharded_mhs"], "unit": "MH/s",
+            "direction": "higher",
+            "differential_ok": mm["differential_ok"],
+            "differential_checks": mm["differential_checks"],
+            "n_devices": mm["n_devices"]}
+        out["mine_mesh_serial"] = {
+            "value": mm["serial_mhs"], "unit": "MH/s",
+            "direction": "higher"}
+        out["mine_mesh_speedup"] = {
+            "value": mm["speedup"], "unit": "x", "direction": "higher"}
+    except Exception as e:
+        log.warning("mining_mesh bench skipped: %s", e)
     return out
 
 

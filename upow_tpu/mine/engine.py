@@ -67,16 +67,21 @@ class MiningJob:
 
 
 def _make_dispatcher(job: MiningJob, backend: str,
-                     mesh_devices: int = 0) -> Optional[Callable]:
+                     mesh_devices: int = 0,
+                     batch: Optional[int] = None) -> Optional[Callable]:
     """For device backends: dispatch(start, count) -> async device handle.
 
     The handle resolves via ``int()``; keeping several dispatches in
     flight hides the host↔device round-trip (which otherwise caps the
     hash rate — measured ~2x on a tunneled v5e chip).
 
-    ``backend='mesh'`` shards each round over the device mesh
-    (shard_map + pmin; config device.mesh_devices caps the mesh size,
-    0 = all visible devices)."""
+    ``backend='mesh'`` routes rounds through the resident mesh engine
+    (mesh_engine.py): one compiled SPMD program per process whose
+    template/target ride as runtime data, each round split across the
+    "dp" mesh by shard_bounds with a pmin hit reduction (config
+    device.mesh_devices caps the mesh size, 0 = all visible devices).
+    ``batch`` is the round size mine() will dispatch — the engine sizes
+    its per-shard capacity from it once, at first use."""
     if backend not in ("pallas", "jnp", "mesh"):
         return None
     from ..device.runtime import get_runtime
@@ -96,29 +101,16 @@ def _make_dispatcher(job: MiningJob, backend: str,
 
         return dispatch
 
+    if backend == "mesh":
+        from .mesh_engine import get_mesh_engine
+
+        # the engine submits every round through the runtime itself
+        # (kernel "sha256_search_mesh", source "mine") and keeps the
+        # per-round shard accounting
+        engine = get_mesh_engine(mesh_devices=mesh_devices, round_hint=batch)
+        return engine.dispatcher(job)
     template = sha_kernel.make_template(job.prefix)
     spec = sha_kernel.target_spec(job.previous_hash, job.difficulty)
-    if backend == "mesh":
-        from ..parallel.mesh import make_mesh, pow_search_sharded
-
-        devices = runtime.devices()
-        if mesh_devices:
-            devices = devices[:mesh_devices]
-        mesh = make_mesh(devices)
-        n_dev = len(devices)
-
-        def dispatch(start: int, count: int):
-            # ceil: cover every nonce in [start, start+count) — a short
-            # final round may overlap the next range or (at the very top
-            # of the space) touch the excluded sentinel nonce
-            # 0xFFFFFFFF / wrap to low nonces in uint32: duplicate work
-            # or the already-documented MAX_SEARCH_END exclusion, never
-            # a missed in-range hit (the min-reduction prefers real hits
-            # over the sentinel)
-            per_dev = max(1, (count + n_dev - 1) // n_dev)
-            return pow_search_sharded(template, spec, start, per_dev, mesh)
-
-        return _through_runtime(dispatch, "sha256_search_mesh")
     fn = sha_kernel.pow_search_pallas if backend == "pallas" else sha_kernel.pow_search_jnp
 
     def dispatch(start: int, count: int):
@@ -189,7 +181,8 @@ def mine(job: MiningJob, backend: str = "jnp", *, start: int = 0,
     tried = 0
     cursor = start
 
-    dispatch = _make_dispatcher(job, backend, mesh_devices=mesh_devices)
+    dispatch = _make_dispatcher(job, backend, mesh_devices=mesh_devices,
+                                batch=batch)
     if dispatch is not None:
         # Pipelined device rounds: keep `depth` dispatches in flight so the
         # chip never idles while the host blocks on a result.  A hit wastes
@@ -207,6 +200,10 @@ def mine(job: MiningJob, backend: str = "jnp", *, start: int = 0,
             tried += count
             if hit != int(sha_kernel.SENTINEL):
                 if job.check(hit):
+                    if backend == "mesh":
+                        from .mesh_engine import get_mesh_engine
+
+                        get_mesh_engine(mesh_devices=mesh_devices).note_hit()
                     return MineResult(hit, tried, time.time() - t0)
                 raise AssertionError(
                     f"backend {backend} returned nonce {hit} failing host check")

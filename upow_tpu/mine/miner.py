@@ -83,12 +83,37 @@ def select_backend(device: str) -> str:
     raise SystemExit(f"unknown device {device!r}")
 
 
+def _runtime_arm_reason() -> Optional[str]:
+    """The device runtime's structured ``arm_failure_reason``, read
+    WITHOUT blocking — the watchdog fires precisely when dispatches are
+    stuck, so it must not wait on the armed-platform event.  None when
+    the runtime never started, armed cleanly, or can't be inspected."""
+    try:
+        from ..device import runtime as _dr
+
+        rt = _dr._RUNTIME
+        if rt is None:
+            return None
+        return rt._arm_info.get("arm_failure_reason")
+    except (ImportError, AttributeError, TypeError):
+        return None
+
+
+#: watchdog exit codes the supervisor decodes in its respawn log
+RC_HANG = 3        # stale heartbeat, backend had armed (device hang)
+RC_ARM_FAILED = 4  # stale heartbeat AND the runtime recorded an arm failure
+
+
 def _start_hang_watchdog(heartbeat: dict, limit: float, _exit=None):
     """A device dispatch on a dropped TPU tunnel HANGS (never raises), so
     the in-loop TTL check can never fire.  This thread hard-exits the
     process when the heartbeat goes stale; the supervisor (reference
     miner.py:149-156's outer watchdog) respawns a fresh process — the
     only reliable recovery once a thread is stuck inside the PJRT client.
+
+    When the device runtime recorded a structured arm failure, the exit
+    message carries that actual reason (and the exit status becomes
+    ``RC_ARM_FAILED``) instead of the generic device-hang guess.
 
     ``heartbeat['limit']`` (optional) overrides ``limit`` — the caller
     raises it for the first round (cold compile can exceed the steady-
@@ -104,9 +129,17 @@ def _start_hang_watchdog(heartbeat: dict, limit: float, _exit=None):
             time.sleep(min(5.0, limit / 4))
             lim = heartbeat.get("limit", limit)
             if time.monotonic() - heartbeat["t"] > lim:
-                print(f"no mining progress for {lim:.0f}s — device hang? "
-                      "exiting for respawn", file=sys.stderr, flush=True)
-                _exit(3)
+                reason = _runtime_arm_reason()
+                if reason:
+                    print(f"no mining progress for {lim:.0f}s — backend "
+                          f"arm failure: {reason}; exiting for respawn",
+                          file=sys.stderr, flush=True)
+                    _exit(RC_ARM_FAILED)
+                else:
+                    print(f"no mining progress for {lim:.0f}s — device "
+                          "hang? exiting for respawn",
+                          file=sys.stderr, flush=True)
+                    _exit(RC_HANG)
                 # os._exit never returns; a test's substitute does — stop
                 # so the thread doesn't keep printing for the rest of the
                 # process lifetime
@@ -207,14 +240,20 @@ def _supervise(args) -> int:
     env = dict(os.environ, UPOW_MINER_CHILD="1")
     cmd = _child_cmd(args) + ["--shard", args.shard]
     child = None
+    rc_meaning = {
+        RC_HANG: "watchdog: device hang (backend had armed)",
+        RC_ARM_FAILED: "watchdog: backend arm failure — the child's "
+                       "stderr above has the structured reason",
+    }
     try:
         while True:
             child = subprocess.Popen(cmd, env=env)
             rc = child.wait()
             if rc == 0:
                 return 0
-            print(f"miner child exited rc={rc}; respawning in 5s",
-                  file=sys.stderr, flush=True)
+            detail = rc_meaning.get(rc, "crash or backend failure")
+            print(f"miner child exited rc={rc} ({detail}); "
+                  "respawning in 5s", file=sys.stderr, flush=True)
             child = None
             time.sleep(5)
     except KeyboardInterrupt:

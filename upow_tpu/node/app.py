@@ -19,6 +19,7 @@ import hashlib
 import json
 import random
 import re
+import sys
 import time
 from collections import deque
 from decimal import Decimal
@@ -754,6 +755,27 @@ class Node:
                       index_stats["shadow_consults"],
                       "Probes answered by the host shadow map"
                       " (ambiguity; steady-state target is zero)")
+        # mesh_engine (via crypto.sha256) imports jax — a host-path node
+        # must not pay that on a scrape, so read stats only when the
+        # mining subsystem already loaded the module itself
+        mesh_mod = sys.modules.get("upow_tpu.mine.mesh_engine")
+        mesh_stats = mesh_mod.engine_stats() if mesh_mod else None
+        if mesh_stats is not None:
+            e.gauge("mine_mesh_shards", mesh_stats["devices"],
+                    "Devices in the resident mesh search program"
+                    " (0 = engine built but not yet armed)")
+            e.gauge("mine_mesh_batch_per_shard",
+                    mesh_stats["batch_per_device"],
+                    "Nonces per shard per round in the resident"
+                    " search program")
+            e.gauge("mine_mesh_armed", int(mesh_stats["armed"]),
+                    "Resident mesh engine armed (compiled + warm)")
+            e.counter("mine_mesh_rounds", mesh_stats["dispatches"],
+                      "Mesh search rounds dispatched through the"
+                      " device runtime")
+        e.gauge("mine_mesh_configured_devices",
+                self.config.device.mesh_devices,
+                "config.device.mesh_devices (0 = all visible)")
         cache_entries = entry_count()
         if cache_entries >= 0:
             e.gauge("compile_cache_persistent_entries", cache_entries,

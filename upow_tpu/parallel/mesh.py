@@ -95,6 +95,65 @@ def _pow_search_mesh(midstate, tail_words, nonce_base, batch_per_device: int,
     )(midstate, tail_words, nonce_base.reshape(1))[0]
 
 
+@functools.partial(
+    jax.jit, static_argnames=("batch_per_device", "nonce_spec", "mesh")
+)
+def _pow_search_mesh_resident(midstate, tail_words, bases, limits, target,
+                              batch_per_device: int, nonce_spec, mesh: Mesh):
+    """Resident mesh search: one compiled SPMD program per (batch,
+    nonce_spec, mesh) whose template AND target ride as runtime data.
+
+    Unlike :func:`_pow_search_mesh` (which bakes the :class:`TargetSpec`
+    into the jit key), every job-specific field — midstate, tail words,
+    per-shard [base, limit) ranges, packed target — is a traced array, so
+    a new job / chain-tip / difficulty change is a pure dispatch: zero
+    recompilation (asserted by the mine_mesh compile-cache counters).
+
+    ``bases``/``limits`` are (n_devices,) u32, sharded over "dp": shard i
+    scans ``[bases[i], bases[i] + batch_per_device)`` with lanes at or
+    past ``limits[i]`` masked off, so uneven ``shard_bounds`` spans and
+    tail rounds need no recompile either.  An empty shard passes
+    ``bases[i] == limits[i]`` (every lane invalid).
+    """
+    shard_map, check_kw = shard_map_compat()
+
+    def per_device(mid, tail, base, limit, tgt):
+        my_base, my_limit = base[0], limit[0]
+        nonces = my_base + jnp.arange(batch_per_device, dtype=jnp.uint32)
+        # u32 wrap past 2**32 makes a lane compare below my_base: both
+        # wrapped and past-limit lanes drop out of the same mask
+        valid = (nonces >= my_base) & (nonces < my_limit)
+        state = tuple(mid[i] for i in range(8))
+        w = sha_kernel._build_w(tail, nonces, nonce_spec)
+        digest = sha_kernel._compress_tail(state, w)
+        hit = sha_kernel._hit_nonce_dynamic(digest, nonces, tgt, valid)
+        return jax.lax.pmin(hit.reshape(1), "dp")
+
+    return shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(), P(), P("dp"), P("dp"), P()),
+        out_specs=P(),
+        **check_kw,
+    )(midstate, tail_words, bases, limits, target)[0]
+
+
+def pow_search_resident(midstate, tail_words, bases, limits, target,
+                        batch_per_device: int, nonce_spec,
+                        mesh: Optional[Mesh] = None):
+    """Dispatch the resident program over explicit per-shard ranges.
+
+    Arguments are already device-typed arrays (the mesh engine keeps the
+    template resident and only swaps these between jobs); returns the
+    global minimum hit nonce (or SENTINEL) after the ``pmin`` collective.
+    """
+    mesh = mesh or make_mesh()
+    return _pow_search_mesh_resident(
+        midstate, tail_words, bases, limits, target,
+        batch_per_device, nonce_spec, mesh,
+    )
+
+
 def pow_search_sharded(template: SearchTemplate, spec: TargetSpec,
                        nonce_base: int, batch_per_device: int,
                        mesh: Optional[Mesh] = None):

@@ -61,6 +61,7 @@ def configure(cfg=None) -> None:
     device.preregister("sha256_txid")
     device.preregister_runtime()
     device.preregister_index()
+    device.preregister_mine()
     for stage in ("block_decode", "block_sig_wait", "accept_probe"):
         device.preregister_stage(stage)
     # shared sig dispatch front (verify/dispatch.py) — deferred import:

@@ -178,6 +178,43 @@ def record_index_probe(outpoints: int, shadow_consults: int,
         metrics.inc("index.ambiguous_probes", int(ambiguous))
 
 
+HIT_LATENCY_BUCKETS = (0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 90.0)
+
+
+def preregister_mine() -> None:
+    """Create the mesh mining families (mine/mesh_engine.py) so /metrics
+    exports them before the first round: the ``mine_mesh`` kernel series
+    (occupancy = real nonces vs shard capacity; compile-cache counters
+    are the no-recompile-job-swap signal) plus the per-shard range
+    occupancy and time-to-hit histograms."""
+    preregister("mine_mesh")
+    metrics.ensure_histogram("mine.shard_occupancy", OCCUPANCY_BUCKETS)
+    metrics.ensure_histogram("mine.hit_latency", HIT_LATENCY_BUCKETS)
+
+
+def record_mine_round(shard_spans, batch_per_device: int,
+                      seconds: Optional[float] = None,
+                      compile_key: Optional[Hashable] = None) -> None:
+    """Record one mesh search round: ``shard_spans`` is the per-shard
+    planned nonce count; capacity per shard is ``batch_per_device``.
+    The compile key is (batch, n_shards, nonce_spec) — job fields are
+    deliberately absent, so a chain-tip change that recompiles would
+    surface as a new key = a ``compile_cache_misses`` increment."""
+    spans = [max(int(s), 0) for s in shard_spans]
+    cap = max(int(batch_per_device), 1)
+    record_batch("mine_mesh", real=sum(spans), padded=cap * len(spans),
+                 seconds=seconds, compile_key=compile_key)
+    for span in spans:
+        metrics.observe("mine.shard_occupancy", min(span / cap, 1.0),
+                        buckets=OCCUPANCY_BUCKETS)
+
+
+def record_mine_hit(latency_seconds: float) -> None:
+    """Record time from job load to winning nonce (mine.hit_latency)."""
+    metrics.observe("mine.hit_latency", max(float(latency_seconds), 0.0),
+                    buckets=HIT_LATENCY_BUCKETS)
+
+
 def record_cost(kernel: str, analysis: dict) -> None:
     """Store an XLA ``compiled.cost_analysis()`` estimate for ``kernel``
     (``upow_tpu/profiling``): numeric entries only, keys sanitized to
