@@ -1,7 +1,7 @@
 PYTHON ?= python
 
 .PHONY: lint test ruff metrics-check perf-observatory perf-smoke swarm \
-	device-runtime-smoke
+	fleet device-runtime-smoke
 
 # Domain linter: consensus-endianness, consensus-purity, jit-purity,
 # dtype-hygiene, async-safety, broad-except, device-runtime purity.
@@ -46,6 +46,16 @@ swarm:
 	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.swarm --matrix fast \
 		--out swarm.json
 
+# Fleet observatory (docs/OBSERVABILITY.md "Fleet observatory"): the
+# deterministic geo-soak run twice (same seed must reproduce the core
+# fingerprint byte-identically), propagation percentiles and the
+# stitched push_tx trace printed, then the fleet kernel rows gated
+# against the committed observatory baseline (fleet_core_ok enforced;
+# it zeroes on any core assertion failure, defeating any tolerance).
+fleet:
+	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.fleet --check-determinism \
+		--trace --out fleet.json --gate-against observatory.json
+
 # CI-sized variant: tiny population, no PROGRESS append.  Gates
 # (report-only) against the committed artifact so every metric —
 # including verify_pipeline, the readpath cache scenario, and the
@@ -61,6 +71,10 @@ swarm:
 # on shared CI hosts are noisy.  mine_mesh_speedup is a ratio of two
 # short measurements (widest band); its correctness trip is the
 # differential zeroing, which defeats any tolerance.
+# fleet_core_ok (ISSUE 13) is ENFORCED the same way: the geo-soak
+# zeroes it on any failed core assertion, so the gate trips on broken
+# distribution semantics; the propagation quantiles are wall-clock
+# under load (widest bands) and report-only by substring.
 perf-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.loadgen --smoke \
 		--out observatory-smoke.json \
@@ -68,6 +82,7 @@ perf-smoke:
 		--enforce kernel.verify_pipeline \
 		--enforce kernel.accept_ \
 		--enforce kernel.mine_mesh \
+		--enforce kernel.fleet_core_ok \
 		--metric-tolerance kernel.verify_pipeline=0.60 \
 		--metric-tolerance kernel.verify_pipeline_serial=0.60 \
 		--metric-tolerance kernel.verify_pipeline_speedup=0.60 \
@@ -76,7 +91,11 @@ perf-smoke:
 		--metric-tolerance kernel.accept_scan_speedup=0.60 \
 		--metric-tolerance kernel.mine_mesh_sharded=0.60 \
 		--metric-tolerance kernel.mine_mesh_serial=0.60 \
-		--metric-tolerance kernel.mine_mesh_speedup=0.45
+		--metric-tolerance kernel.mine_mesh_speedup=0.45 \
+		--metric-tolerance kernel.fleet_block_prop_p50_ms=3.0 \
+		--metric-tolerance kernel.fleet_block_prop_p95_ms=3.0 \
+		--metric-tolerance kernel.fleet_tx_prop_p50_ms=3.0 \
+		--metric-tolerance kernel.fleet_tx_prop_p95_ms=3.0
 
 # Device-runtime gate (docs/DEVICE_RUNTIME.md): the fairness /
 # coalescing / degrade-flip / arm-failure test matrix, then the DR

@@ -136,6 +136,27 @@ def _merge_env_ladder(attempts: list) -> list:
 # timeline and the bench's own arm story interleave in one place.
 _BENCH_EVENTS = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".bench_events.jsonl")
+_BENCH_EVENTS_MAX = 1 << 20     # rotate past 1 MiB (soak runs append forever)
+
+
+def _rotate_keep_tail(path: str, max_bytes: int) -> None:
+    """Size-cap an append-only log: past ``max_bytes``, keep the newest
+    half aligned to a line boundary (atomic replace, never raises)."""
+    try:
+        if os.path.getsize(path) <= max_bytes:
+            return
+        with open(path, "rb") as f:
+            f.seek(-(max_bytes // 2), os.SEEK_END)
+            tail = f.read()
+        cut = tail.find(b"\n")
+        if cut >= 0:
+            tail = tail[cut + 1:]
+        tmp = path + ".rot"
+        with open(tmp, "wb") as f:
+            f.write(tail)
+        os.replace(tmp, path)
+    except OSError:
+        pass
 
 
 def _record_bench_event(kind: str, **fields) -> None:
@@ -144,6 +165,7 @@ def _record_bench_event(kind: str, **fields) -> None:
     entry = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S%z"), "kind": kind,
              **fields}
     try:
+        _rotate_keep_tail(_BENCH_EVENTS, _BENCH_EVENTS_MAX)
         with open(_BENCH_EVENTS, "a") as f:
             f.write(json.dumps(entry, sort_keys=True) + "\n")
     except OSError as e:

@@ -60,9 +60,35 @@ _QUEUE = [
 ]
 
 
+_LOG_MAX = 4 << 20          # a watcher left running for days appends
+_EVENTS_MAX = 1 << 20       # forever; both logs rotate in place
+
+
+def _rotate_keep_tail(path: str, max_bytes: int) -> None:
+    """Size-cap an append-only log: past ``max_bytes``, keep the newest
+    half aligned to a line boundary (atomic replace, never raises —
+    losing old chatter must not take the watcher down)."""
+    try:
+        if os.path.getsize(path) <= max_bytes:
+            return
+        with open(path, "rb") as f:
+            f.seek(-(max_bytes // 2), os.SEEK_END)
+            tail = f.read()
+        cut = tail.find(b"\n")
+        if cut >= 0:
+            tail = tail[cut + 1:]
+        tmp = path + ".rot"
+        with open(tmp, "wb") as f:
+            f.write(tail)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def _log(msg: str) -> None:
     line = f"[{time.strftime('%H:%M:%S')}] {msg}"
     print(line, flush=True)
+    _rotate_keep_tail(_LOG, _LOG_MAX)
     with open(_LOG, "a") as f:
         f.write(line + "\n")
 
@@ -74,6 +100,7 @@ def _record_event(kind: str, **fields) -> None:
     record = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S%z"), "kind": kind}
     record.update(fields)
     try:
+        _rotate_keep_tail(_EVENTS, _EVENTS_MAX)
         with open(_EVENTS, "a") as f:
             f.write(json.dumps(record, sort_keys=True) + "\n")
     except OSError as e:
@@ -98,6 +125,7 @@ def _save_state(state: dict) -> None:
 def _run_grouped(argv, deadline: float, log_name: str) -> int:
     """Run argv in its own session; kill -9 the whole group on deadline.
     Output streams to tpu_watch.log so partial progress survives."""
+    _rotate_keep_tail(_LOG, _LOG_MAX)
     with open(_LOG, "a") as logf:
         logf.write(f"--- {log_name}: {' '.join(argv)}\n")
         logf.flush()

@@ -328,6 +328,8 @@ class TelemetryConfig:
     events_buffer: int = 256        # /debug/events ring size
     max_metric_names: int = 1024    # cardinality cap per registry kind
     debug_endpoints: bool = True    # serve /debug/traces, /debug/events
+    instance_scope: bool = False    # per-node registries (swarm fleets);
+                                    # default keeps the process globals
 
 
 @dataclass

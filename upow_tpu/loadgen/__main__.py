@@ -75,7 +75,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(json.dumps({
         "artifact": args.out,
         "events": artifact["slo"]["events"],
-        "endpoints": {ep: {"req_s": row["req_s"], "p95_ms": row["p95_ms"]}
+        # fleet propagation/node rows carry quantiles only — no req_s
+        "endpoints": {ep: {"req_s": row.get("req_s"),
+                           "p95_ms": row.get("p95_ms")}
                       for ep, row in artifact["slo"]["endpoints"].items()},
         "provenance": artifact["provenance"],
     }, sort_keys=True))

@@ -27,7 +27,14 @@ on one fails the gate even under ``--report-only`` (how ``make
 perf-smoke`` keeps its advisory report while hard-gating the verify
 pipeline and resident accept kernels).
 
-Exit codes: 0 ok / report-only, 1 regression(s), 2 usage error.
+``--trend PROGRESS.jsonl`` switches to trend-report mode: every
+``perf_observatory`` line in the trajectory file (driver records with
+other kinds are skipped) becomes one sample per metric, and the report
+carries direction-aware per-metric trend lines (first → last, best /
+worst, improving / regressing / flat).  Trend mode never fails the
+build — it is a trajectory report, not a gate.
+
+Exit codes: 0 ok / report-only / trend, 1 regression(s), 2 usage error.
 """
 
 from __future__ import annotations
@@ -175,13 +182,98 @@ def compare(baseline: Dict[str, float], current: Dict[str, float],
     return rows
 
 
+def _flatten_progress_line(line: dict) -> Dict[str, float]:
+    """Flatten one PROGRESS.jsonl ``perf_observatory`` line (its slo
+    block is ``{ep: row}`` without the artifact's ``endpoints``
+    wrapper, and its kernels are plain values)."""
+    out: Dict[str, float] = {}
+    for ep, row in (line.get("slo") or {}).items():
+        if not isinstance(row, dict):
+            continue
+        for field in ("req_s", "p50_ms", "p95_ms", "p99_ms"):
+            v = _num(row.get(field))
+            if v is not None:
+                out[f"slo.{ep}.{field}"] = v
+    for name, value in (line.get("kernels") or {}).items():
+        if name == "last_good_tpu":
+            continue
+        v = _num(value)
+        if v is not None:
+            out[f"kernel.{name}"] = v
+    return out
+
+
+def trend_report(path: str) -> dict:
+    """Direction-aware per-metric trajectory over a PROGRESS.jsonl
+    history.  Non-observatory lines (the driver's own records share the
+    file) are skipped by ``kind``."""
+    samples: List[Dict[str, float]] = []
+    with open(path) as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                line = json.loads(raw)
+            except ValueError:
+                continue  # interleaved log noise
+            if not isinstance(line, dict) \
+                    or line.get("kind") != "perf_observatory":
+                continue
+            flat = _flatten_progress_line(line)
+            if flat:
+                samples.append(flat)
+
+    series: Dict[str, List[float]] = {}
+    for flat in samples:
+        for metric, value in flat.items():
+            series.setdefault(metric, []).append(value)
+
+    rows = []
+    for metric in sorted(series):
+        vals = series[metric]
+        first, last = vals[0], vals[-1]
+        lower = lower_is_better(metric)
+        if first == 0:
+            change_pct = None
+            verdict = "flat" if last == 0 else (
+                "regressing" if lower else "improving")
+        else:
+            change = (last - first) / abs(first)
+            change_pct = round(change * 100.0, 2)
+            if abs(change) < 0.02:
+                verdict = "flat"
+            elif (change > 0) != lower:
+                verdict = "improving"
+            else:
+                verdict = "regressing"
+        rows.append({
+            "metric": metric,
+            "samples": len(vals),
+            "first": first, "last": last,
+            "best": min(vals) if lower else max(vals),
+            "worst": max(vals) if lower else min(vals),
+            "direction": "lower" if lower else "higher",
+            "change_pct": change_pct,
+            "trend": verdict,
+        })
+    order = {"regressing": 0, "flat": 1, "improving": 2}
+    rows.sort(key=lambda r: (order[r["trend"]], r["metric"]))
+    return {"kind": "trend_report", "progress": path,
+            "observatory_lines": len(samples), "metrics": rows}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m upow_tpu.loadgen.gate",
         description="Fail when a metric regresses beyond tolerance.")
-    ap.add_argument("--against", required=True,
+    ap.add_argument("--against",
                     help="baseline artifact (BENCH_r*.json, bench_suite "
                          "stream, or observatory.json)")
+    ap.add_argument("--trend", metavar="PROGRESS_JSONL",
+                    help="report per-metric trend lines over a "
+                         "PROGRESS.jsonl history instead of gating "
+                         "(always exits 0)")
     ap.add_argument("--current", default="observatory.json",
                     help="current artifact (default: observatory.json)")
     ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
@@ -200,6 +292,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "fail the gate even under --report-only "
                          "(repeatable)")
     args = ap.parse_args(argv)
+
+    if args.trend:
+        try:
+            report = trend_report(args.trend)
+        except OSError as e:
+            print(f"gate: cannot read progress file: {e}",
+                  file=sys.stderr)
+            return 2
+        print(json.dumps(report, indent=1, sort_keys=True))
+        for r in report["metrics"]:
+            if r["trend"] != "flat":
+                pct = f"{r['change_pct']:+}%" \
+                    if r["change_pct"] is not None else "n/a"
+                print(f"trend: {r['trend']:>10} {r['metric']} "
+                      f"{r['first']} -> {r['last']} ({pct}, "
+                      f"{r['direction']} is better)", file=sys.stderr)
+        return 0
+
+    if not args.against:
+        ap.error("--against is required (unless --trend)")
 
     metric_tolerances: Dict[str, float] = {}
     for spec in args.metric_tolerance:

@@ -17,6 +17,11 @@ structured JSON artifact:
   (:mod:`.coresidency`): miner + block verify + mempool intake on one
   runtime, cross-source coalescing and fairness deltas with the same
   differential-gated mirroring into ``kernels``.
+* ``fleet`` — the deterministic geo-soak (:mod:`..fleet.geosoak`):
+  cross-node propagation percentiles, the stitched push_tx trace
+  span, and ``fleet_core_ok`` mirrored into ``kernels`` with the
+  propagation quantiles (zeroed on any core assertion failure so the
+  enforced gate trips on broken distribution semantics).
 * ``provenance`` — what actually ran: ``backend``, ``platform``,
   ``attempted_backend``, ``arm_failure_reason``, ``arm_attempt``
   (which arm attempt produced this process — ``runtime`` /
@@ -271,6 +276,19 @@ def run_observatory(spec: Optional[PopulationSpec] = None,
                 "value": conc["verify_wait_p99_ms"], "unit": "ms",
                 "direction": "lower"}
 
+    fleet = None
+    try:
+        from ..fleet.geosoak import observatory_section
+
+        fleet = observatory_section()
+    except Exception as e:
+        log.warning("fleet geo-soak skipped: %s", e)
+    if fleet is not None:
+        # direction-annotated rows (fleet_core_ok zeroes on any failed
+        # core assertion, defeating any gate tolerance — same idiom as
+        # the differential-zeroed kernel headlines above)
+        kernels.update(fleet["kernels"])
+
     if cost:
         try:
             analysis = _kernel_cost_analysis()
@@ -312,6 +330,11 @@ def run_observatory(spec: Optional[PopulationSpec] = None,
         artifact["readpath"] = readpath
     if coresidency is not None:
         artifact["coresidency"] = coresidency
+    if fleet is not None:
+        artifact["fleet"] = fleet["section"]
+        # per-node fleet latency rows + propagation quantile rows ride
+        # the endpoint table (names are fleet.-prefixed: no collisions)
+        artifact["slo"]["endpoints"].update(fleet["slo_endpoints"])
     return artifact
 
 

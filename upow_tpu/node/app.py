@@ -105,6 +105,15 @@ class Node:
         self.config = config or Config()
         setup_logging(self.config.log)
         telemetry.configure(self.config.telemetry)
+        # Instance-scoped registries (swarm fleets): every request this
+        # node handles — and every task spawned underneath, contextvars
+        # travel with ensure_future — reports into this node's private
+        # metrics/events/traces instead of the process globals.  Default
+        # (single-node) keeps the globals: scope stays None.
+        self.telemetry_scope = None
+        if self.config.telemetry.instance_scope:
+            self.telemetry_scope = telemetry.TelemetryScope.from_config(
+                self.config.telemetry)
         self.config.device.apply_kernel_overrides()
         if state is not None:
             # injected backend (tests: the pg backend over the mock
@@ -357,6 +366,15 @@ class Node:
     # -------------------------------------------------------- middleware --
     @web.middleware
     async def _middleware(self, request: web.Request, handler):
+        # bind this node's telemetry scope around the WHOLE request —
+        # including /metrics and /debug reads, so each node serves its
+        # own registries even with 50 nodes in one process
+        if self.telemetry_scope is not None:
+            with self.telemetry_scope.activate():
+                return await self._middleware_inner(request, handler)
+        return await self._middleware_inner(request, handler)
+
+    async def _middleware_inner(self, request: web.Request, handler):
         client_ip = self._client_ip(request)
         if not self.ip_filter.allowed(client_ip):
             return web.json_response(
@@ -488,6 +506,9 @@ class Node:
         broadcast, dedup cache, log line."""
         if sender:
             self.peers.update_last_message(sender)
+        # first-seen stamp for the fleet propagation tracker: one event
+        # per node per accepted tx (duplicates are rejected upstream)
+        telemetry.event("tx_seen", hash=tx_hash)
         self._spawn(self.propagate("push_tx", {"tx_hex": tx.hex()}))
         if self.ws_hub is not None:
             amount = sum(o.amount for o in tx.outputs)
@@ -1873,7 +1894,11 @@ class Node:
             res.canonical for res in r.resources()
             if res.canonical.startswith("/")
             and not res.canonical.startswith(("/ws", "/debug"))}
-        telemetry.slo.preregister(self._slo_paths)
+        if self.telemetry_scope is not None:
+            with self.telemetry_scope.activate():
+                telemetry.slo.preregister(self._slo_paths)
+        else:
+            telemetry.slo.preregister(self._slo_paths)
         return app
 
 

@@ -60,6 +60,10 @@ def swarm_config(ws: bool = False, ws_queue_max: int = 0,
     # default rings are sized for one
     cfg.telemetry.trace_recent = 512
     cfg.telemetry.events_buffer = 4096
+    # every node gets its own metrics/SLO/events/trace registries —
+    # 50 in-loop nodes must not clobber one process-global registry
+    # (fleet scraper + scenario assertions read them per node)
+    cfg.telemetry.instance_scope = True
     # every node is the sole writer of its in-memory state, so the
     # read cache never needs foreign-writer revalidation — leaving it
     # on would let the periodic re-anchor mask a missing invalidation
@@ -88,6 +92,10 @@ class Swarm:
         self.urls: List[str] = []
         self.ips: List[str] = []
         self.driver = "http://driver.local"  # unregistered: no shaping
+        # per-node black box (fleet/recorder.py): scenario drivers mark
+        # phase boundaries; run_scenario dumps on failure/fault/breach
+        from ..fleet.recorder import FlightRecorder
+        self.recorder = FlightRecorder()
 
     # -------------------------------------------------------------- build --
     async def start(self, topology: str = "mesh") -> "Swarm":
@@ -101,6 +109,8 @@ class Swarm:
             node = Node(cfg)
             node.self_url = url
             node.started = True  # skip first-request bootstrap
+            if node.telemetry_scope is not None:
+                node.telemetry_scope.name = f"node{i}"
             node.iface_factory = self._factory(url)
             node.app.freeze()
             await node.app.startup()

@@ -37,6 +37,8 @@ from decimal import Decimal
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import telemetry
+from ..fleet import recorder as fleet_recorder
+from ..fleet import scrape as fleet_scrape
 from ..logger import get_logger
 from ..resilience import faultinject
 from .harness import Swarm
@@ -103,9 +105,16 @@ def _breaker_flips(swarm: Swarm) -> int:
                for peer in snap.values())
 
 
-def _roots_for(trace_id: str) -> List[dict]:
-    return [t for t in telemetry.traces()["recent"]
-            if t.get("trace_id") == trace_id]
+def _roots_for(swarm: Swarm, trace_id: str) -> List[dict]:
+    """Trace roots for one id across the whole fleet: with per-node
+    registries the driver's buffer only holds driver-opened roots, so
+    cross-node assertions must read the merged view."""
+    return fleet_scrape.merged_trace_roots(swarm, trace_id=trace_id)
+
+
+def core_ok(core: dict) -> bool:
+    """True when every boolean assertion in a core dict held."""
+    return all(v for v in core.values() if isinstance(v, bool))
 
 
 # ----------------------------------------------------------- scenarios ----
@@ -184,8 +193,8 @@ async def scenario_partition_heal(swarm: Swarm, seed: int):
         if stale_balances[i][1] != winner_balance:
             stale_differed = True
 
-    reorgs = telemetry.events.snapshot(kind="reorg")
-    roots = _roots_for(heal_tid)
+    reorgs = fleet_scrape.merged_events(swarm, kind="reorg")
+    roots = _roots_for(swarm, heal_tid)
     root_names = {t.get("name") for t in roots}
     core = {
         "diverged_during_partition": diverged,
@@ -261,10 +270,12 @@ async def scenario_reorg_storm(swarm: Swarm, seed: int):
         "cycles": cycles,
         "all_converged": all(c["converged"] for c in cycles),
         "reorged_every_cycle":
-            len(telemetry.events.snapshot(kind="reorg")) >= len(b_idx) * 2,
+            len(fleet_scrape.merged_events(swarm, kind="reorg"))
+            >= len(b_idx) * 2,
     }
     observed = {
-        "reorg_events": len(telemetry.events.snapshot(kind="reorg")),
+        "reorg_events": len(fleet_scrape.merged_events(swarm,
+                                                       kind="reorg")),
         "breaker_flips": _breaker_flips(swarm),
     }
     return core, observed
@@ -566,6 +577,9 @@ class ScenarioSpec:
     fast: bool                # member of the CI fast matrix
     topology: str = "mesh"
     swarm_kwargs: dict = field(default_factory=dict)
+    # flight-recorder SLO trigger: a per-node p99 above this dumps the
+    # black box into the artifact (None = no latency trigger)
+    p99_budget_ms: Optional[float] = None
 
 
 SCENARIOS: Dict[str, ScenarioSpec] = {
@@ -586,6 +600,17 @@ SCENARIOS: Dict[str, ScenarioSpec] = {
         swarm_kwargs={"ws": True, "ws_queue_max": 4}),
 }
 
+# The geo soak lives in the fleet package (fleet/geosoak.py: continent
+# latency matrix + churn + propagation quantiles) but registers here so
+# the matrix/CLI/artifact machinery treats it like any other scenario.
+# Import placed AFTER the registry: geosoak defers every swarm import
+# to call time, so this is the only edge and cannot cycle.
+from ..fleet.geosoak import scenario_geo_soak  # noqa: E402
+
+SCENARIOS["geo_soak"] = ScenarioSpec(
+    scenario_geo_soak, nodes=6, fast=True,
+    swarm_kwargs={"reorg_window": 4}, p99_budget_ms=2000.0)
+
 
 # ------------------------------------------------------------- artifact ----
 
@@ -599,15 +624,21 @@ def artifact_fingerprint(core: dict) -> str:
 async def _drive(spec: ScenarioSpec, n: int, seed: int):
     swarm = Swarm(n, seed=seed, **spec.swarm_kwargs)
     await swarm.start(topology=spec.topology)
+    swarm.recorder.mark(swarm, label="start")
     try:
         core, observed = await spec.fn(swarm, seed)
         observed = dict(observed)
         observed["links"] = swarm.matrix.stats()
         observed["breakers"] = swarm.breaker_summary()
         slo = swarm.slo_summary()
+        # black-box capture happens while the node scopes are live;
+        # whether the dump lands in the artifact is decided later
+        swarm.recorder.mark(swarm, label="final")
+        fleet_events = fleet_scrape.merged_events(swarm)
     finally:
         await swarm.close()
-    return core, observed, slo
+    return core, observed, slo, {"events": fleet_events,
+                                 "recorder": swarm.recorder}
 
 
 def run_scenario(name: str, nodes: Optional[int] = None,
@@ -618,13 +649,14 @@ def run_scenario(name: str, nodes: Optional[int] = None,
     n = nodes or spec.nodes
     t0 = time.perf_counter()
     with deterministic_world(seed):
-        core, observed, slo = asyncio.run(_drive(spec, n, seed))
+        core, observed, slo, blackbox = asyncio.run(_drive(spec, n, seed))
     elapsed = time.perf_counter() - t0
     core = {"scenario": name, "seed": seed, "nodes": n, **core}
     observed["elapsed_s"] = round(elapsed, 3)
     log.info("scenario %s (n=%d seed=%d) done in %.2fs", name, n, seed,
              elapsed)
-    return {
+    slo_rows = {f"swarm.{name}.{node}": row for node, row in slo.items()}
+    artifact = {
         "kind": "swarm_scenario",
         "scenario": name,
         "seed": seed,
@@ -632,9 +664,18 @@ def run_scenario(name: str, nodes: Optional[int] = None,
         "core": core,
         "fingerprint": artifact_fingerprint(core),
         "observed": observed,
-        "slo": {"endpoints": {f"swarm.{name}.{node}": row
-                              for node, row in slo.items()}},
+        "slo": {"endpoints": slo_rows},
     }
+    # flight recorder: core failure / injected fault / SLO breach ⇒
+    # the black box (per-node frames) lands next to the failure
+    reason = fleet_recorder.trigger_reason(
+        core_ok(core), blackbox["events"], slo_rows=slo_rows,
+        p99_budget_ms=spec.p99_budget_ms)
+    if reason is not None:
+        artifact["flight_recorder"] = blackbox["recorder"].dump(reason)
+        log.warning("scenario %s: flight recorder dumped (%s)", name,
+                    reason)
+    return artifact
 
 
 def run_matrix(which: str = "fast", seed: int = 7) -> dict:

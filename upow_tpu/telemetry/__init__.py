@@ -24,13 +24,15 @@ from contextlib import contextmanager
 from typing import Optional
 
 from ..logger import get_logger
-from . import device, events, exposition, metrics, slo, tracing
+from . import device, events, exposition, metrics, scope, slo, tracing
 from .events import emit as event
 from .metrics import (counters, ensure_counter, ensure_histogram,  # noqa: F401
                       histograms, inc, observe, stats)
+from .scope import TelemetryScope  # noqa: F401
 from .tracing import (add_span, attached, child_span, current_span,  # noqa: F401
                       current_trace_id, finish_child, new_trace_id,
-                      request_trace, span, traces, valid_trace_id)
+                      open_traces, request_trace, span, traces,
+                      valid_trace_id)
 
 log = get_logger("telemetry")
 
@@ -38,12 +40,13 @@ log = get_logger("telemetry")
 TRACE_HEADER = "X-Upow-Trace"
 
 __all__ = [
-    "TRACE_HEADER", "add_span", "attached", "child_span", "configure",
-    "counters", "current_span", "current_trace_id", "device",
-    "ensure_counter", "ensure_histogram", "event", "events",
-    "exposition", "finish_child", "histograms", "inc", "metrics",
-    "new_trace_id", "observe", "profile", "request_trace", "reset",
-    "slo", "span", "stats", "traces", "tracing", "valid_trace_id",
+    "TRACE_HEADER", "TelemetryScope", "add_span", "attached",
+    "child_span", "configure", "counters", "current_span",
+    "current_trace_id", "device", "ensure_counter", "ensure_histogram",
+    "event", "events", "exposition", "finish_child", "histograms",
+    "inc", "metrics", "new_trace_id", "observe", "open_traces",
+    "profile", "request_trace", "reset", "scope", "slo", "span",
+    "stats", "traces", "tracing", "valid_trace_id",
 ]
 
 

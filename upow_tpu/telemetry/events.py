@@ -5,7 +5,12 @@ transitions, fault injections — are worth keeping verbatim rather
 than only as counters: when a node misbehaves, the sequence and the
 trace IDs matter.  ``emit()`` stamps each record with wall-clock time
 and the current trace ID (None when emitted outside a traced
-context, e.g. from an executor thread)."""
+context, e.g. from an executor thread).
+
+The ring lives in an ``EventRing`` instance; the module functions
+resolve the target per call — the ring of the active telemetry scope
+(one per swarm node) or the process-global ring when no scope is
+bound (single-node path, unchanged)."""
 
 from __future__ import annotations
 
@@ -14,40 +19,65 @@ import time
 from collections import deque
 from typing import Any, List, Optional
 
-from . import tracing
+from . import scope, tracing
 
-_lock = threading.Lock()
-_events: deque = deque(maxlen=256)
+
+class EventRing:
+    """Bounded oldest-evicting ring of structured event records."""
+
+    def __init__(self, maxlen: int = 256):
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=max(1, int(maxlen)))
+
+    def configure(self, maxlen: int = 256) -> None:
+        with self._lock:
+            self._events = deque(self._events, maxlen=max(1, int(maxlen)))
+
+    def emit(self, kind: str, **fields: Any) -> None:
+        rec = {"ts": round(time.time(), 6), "kind": kind,
+               "trace_id": tracing.current_trace_id()}
+        for k, v in fields.items():
+            rec[k] = v if isinstance(v, (str, int, float, bool)) \
+                or v is None else str(v)
+        with self._lock:
+            self._events.append(rec)
+
+    def snapshot(self, limit: Optional[int] = None,
+                 kind: Optional[str] = None) -> List[dict]:
+        """Events oldest-first; optionally last ``limit`` of one kind."""
+        with self._lock:
+            out = list(self._events)
+        if kind is not None:
+            out = [e for e in out if e["kind"] == kind]
+        if limit is not None:
+            out = out[-max(0, int(limit)):]
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+_global = EventRing()
+
+
+def _ring() -> EventRing:
+    sc = scope.current()
+    return sc.events if sc is not None else _global
 
 
 def configure(maxlen: int = 256) -> None:
-    global _events
-    with _lock:
-        _events = deque(_events, maxlen=max(1, int(maxlen)))
+    _ring().configure(maxlen)
 
 
 def emit(kind: str, **fields: Any) -> None:
-    rec = {"ts": round(time.time(), 6), "kind": kind,
-           "trace_id": tracing.current_trace_id()}
-    for k, v in fields.items():
-        rec[k] = v if isinstance(v, (str, int, float, bool)) or v is None \
-            else str(v)
-    with _lock:
-        _events.append(rec)
+    _ring().emit(kind, **fields)
 
 
 def snapshot(limit: Optional[int] = None,
              kind: Optional[str] = None) -> List[dict]:
-    """Events oldest-first; optionally the last ``limit`` of one kind."""
-    with _lock:
-        out = list(_events)
-    if kind is not None:
-        out = [e for e in out if e["kind"] == kind]
-    if limit is not None:
-        out = out[-max(0, int(limit)):]
-    return out
+    return _ring().snapshot(limit, kind)
 
 
 def reset() -> None:
-    with _lock:
-        _events.clear()
+    _ring().reset()

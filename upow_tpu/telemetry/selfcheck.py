@@ -1,12 +1,15 @@
 """``make metrics-check``: boot the node app in-process, scrape
-``/metrics``, and run the exposition-format validator.
+``/metrics``, and run the exposition-format validator — then boot a
+3-node swarm, merge its per-node scrapes into the ``upow_fleet_*``
+families and validate those too.
 
 This is the CI gate for the observability surface: it fails when any
 exported name is illegal, any histogram's cumulative buckets regress,
 the content type drifts from 0.0.4, a required metric family
-disappears, or a /debug endpoint stops returning well-formed JSON.
-Runs against an in-memory sqlite chain with networking disabled — no
-sockets, no peers, exactly like the test-suite clusters.
+disappears (single-node or fleet), or a /debug endpoint stops
+returning well-formed JSON.  Runs against in-memory sqlite chains with
+networking disabled — no sockets, no peers, exactly like the
+test-suite clusters.
 """
 
 from __future__ import annotations
@@ -26,6 +29,18 @@ REQUIRED = (
     "upow_kernel_p256_verify_compile_cache_misses_total",
     "upow_block_height",
     "upow_mempool_transactions",
+)
+
+#: families the merged fleet rendering must always carry
+#: (substring match on the render_fleet output)
+REQUIRED_FLEET = (
+    "upow_fleet_nodes",
+    "upow_fleet_height_spread",
+    "upow_fleet_events_total",
+    "upow_fleet_traces_total",
+    "upow_fleet_block_propagation_p95_ms",
+    "upow_fleet_block_propagation_seconds_bucket",
+    "upow_fleet_tx_propagation_seconds_bucket",
 )
 
 
@@ -77,8 +92,52 @@ async def _run() -> int:
     return 0
 
 
+async def _run_fleet() -> int:
+    """Fleet half of the gate: 3 scoped nodes, one gossiped block, the
+    merged ``upow_fleet_*`` rendering through the same validator."""
+    from ..fleet import scrape
+    from ..swarm.harness import Swarm
+    from ..swarm.scenarios import _wallet, deterministic_world
+
+    failures = []
+    with deterministic_world(0):
+        async def drive():
+            swarm = await Swarm(3, seed=0).start()
+            try:
+                _, addr = _wallet(0, "metrics_check")
+                res = await swarm.mine(0, addr)
+                if not res.get("ok"):
+                    failures.append(f"fleet bootstrap mine failed: {res}")
+                await swarm.wait_converged()
+                await swarm.settle()
+                return await scrape.scrape(swarm)
+            finally:
+                await swarm.close()
+
+        snapshot = await drive()
+    for label, rec in snapshot["nodes"].items():
+        if rec["metrics_status"] != 200:
+            failures.append(f"{label} /metrics -> {rec['metrics_status']}")
+        failures.extend(f"{label}: {v}"
+                        for v in exposition.validate(rec["metrics_text"]))
+    text = scrape.render_fleet(snapshot)
+    failures.extend(f"fleet: {v}" for v in exposition.validate(text))
+    for name in REQUIRED_FLEET:
+        if name not in text:
+            failures.append(f"required fleet metric missing: {name}")
+    if failures:
+        for f in failures:
+            print(f"metrics-check: FAIL {f}")
+        return 1
+    print(f"metrics-check: OK fleet ({len(snapshot['nodes'])} nodes "
+          f"merged, {len(text.splitlines())} exposition lines, "
+          f"{len(REQUIRED_FLEET)} required fleet families present)")
+    return 0
+
+
 def main() -> int:
-    return asyncio.run(_run())
+    rc = asyncio.run(_run())
+    return rc or asyncio.run(_run_fleet())
 
 
 if __name__ == "__main__":

@@ -34,7 +34,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 from ..logger import get_logger
-from . import metrics
+from . import metrics, scope as _scope
 
 log = get_logger("telemetry")
 
@@ -113,10 +113,17 @@ def _jsonable(v: Any) -> Any:
 
 
 class TraceBuffer:
-    """Bounded retention of completed traces: recent ring + slowest top-N."""
+    """Bounded retention of completed traces: recent ring + slowest top-N.
+
+    Also tracks the roots currently *in flight* (opened by
+    ``request_trace`` but not yet recorded), so a flight recorder can
+    snapshot what a node was doing at the moment of a failure."""
+
+    _OPEN_CAP = 256
 
     def __init__(self, recent: int = 32, slowest: int = 16):
         self._lock = threading.Lock()
+        self._open: Dict[int, Span] = {}
         self.configure(recent, slowest)
 
     def configure(self, recent: int, slowest: int) -> None:
@@ -125,9 +132,27 @@ class TraceBuffer:
             self._slowest: List[dict] = []
             self._slow_cap = max(1, int(slowest))
 
+    def record_open(self, root: Span) -> None:
+        with self._lock:
+            if len(self._open) < self._OPEN_CAP:
+                self._open[id(root)] = root
+
+    def discard_open(self, root: Span) -> None:
+        with self._lock:
+            self._open.pop(id(root), None)
+
+    def open_snapshot(self) -> List[dict]:
+        """In-flight (not yet recorded) trace roots, oldest first."""
+        with self._lock:
+            roots = list(self._open.values())
+        out = [r.to_dict() for r in roots if not r.done]
+        out.sort(key=lambda d: d["start_ts"])
+        return out
+
     def record(self, root: Span) -> None:
         snap = root.to_dict()
         with self._lock:
+            self._open.pop(id(root), None)
             self._recent.append(snap)
             self._slowest.append(snap)
             self._slowest.sort(key=lambda t: t["duration_ms"], reverse=True)
@@ -142,10 +167,21 @@ class TraceBuffer:
         with self._lock:
             self._recent.clear()
             self._slowest.clear()
+            self._open.clear()
 
 
 _buffer = TraceBuffer()
 _max_spans = 512
+
+
+def _buf() -> TraceBuffer:
+    sc = _scope.current()
+    return sc.traces if sc is not None else _buffer
+
+
+def _span_budget() -> int:
+    sc = _scope.current()
+    return sc.max_trace_spans if sc is not None else _max_spans
 
 
 def configure(recent: int = 32, slowest: int = 16,
@@ -156,7 +192,12 @@ def configure(recent: int = 32, slowest: int = 16,
 
 
 def traces() -> dict:
-    return _buffer.snapshot()
+    return _buf().snapshot()
+
+
+def open_traces() -> List[dict]:
+    """In-flight trace roots of the active scope (or the globals)."""
+    return _buf().open_snapshot()
 
 
 def current_span() -> Optional[Span]:
@@ -175,7 +216,7 @@ def _attach(parent: Span, child: Span) -> bool:
     # per-root span budget lives in the root's field dict (kept out of
     # Span.__slots__; stripped before the tree is recorded)
     used = root.fields.get("_spans", 0)
-    if used >= _max_spans:
+    if used >= _span_budget():
         return False
     root.fields["_spans"] = used + 1
     parent.children.append(child)
@@ -188,6 +229,8 @@ def request_trace(name: str, trace_id: Optional[str] = None,
     """Open a root span; on exit record the tree into the ring buffer."""
     tid = trace_id if valid_trace_id(trace_id) else new_trace_id()
     root = Span(name, trace_id=tid, **fields)
+    buf = _buf()  # pin the buffer so open/record hit the same scope
+    buf.record_open(root)
     token = _current.set(root)
     try:
         yield root
@@ -199,7 +242,7 @@ def request_trace(name: str, trace_id: Optional[str] = None,
         root.finish()
         root.fields.pop("_spans", None)
         metrics.record_span(name, root.duration_s)
-        _buffer.record(root)
+        buf.record(root)
 
 
 @contextlib.contextmanager
@@ -292,4 +335,4 @@ def attached(sp: Optional[Span]):
 
 
 def reset() -> None:
-    _buffer.reset()
+    _buf().reset()

@@ -34,7 +34,7 @@ from ..core.rewards import get_block_reward, get_inode_rewards
 from ..core.tx import CoinbaseTx, Tx, TxOutput
 from ..state.storage import ChainState, _INPUT_TABLE
 from ..telemetry import device as ktel
-from ..trace import span
+from ..trace import event, span
 from .dispatch import get_front
 from .txverify import TxVerifier, run_sig_checks_async  # noqa: F401  (re-exported for tests)
 
@@ -597,6 +597,9 @@ class BlockManager:
             self._notify_pending_removed(
                 [tx.hash() for tx in transactions])
         self._notify_committed()
+        # first-seen stamp for the fleet propagation tracker: emitted
+        # once per node per committed block (timed accept path)
+        event("block_seen", hash=block_hash, height=block_no)
 
         if block_no % 10 == 0:
             fingerprint = await self.state.get_unspent_outputs_hash()
@@ -671,6 +674,8 @@ class BlockManager:
             self._notify_pending_removed(
                 [tx.hash() for tx in transactions])
         self._notify_committed()
+        # first-seen stamp, sync-accept path (same semantics as timed)
+        event("block_seen", hash=block_hash, height=block_no)
         self.invalidate_difficulty()
         return True
 
