@@ -1,7 +1,7 @@
 PYTHON ?= python
 
 .PHONY: lint test ruff metrics-check perf-observatory perf-smoke swarm \
-	fleet device-runtime-smoke
+	fleet device-runtime-smoke snapshot-smoke
 
 # Domain linter: consensus-endianness, consensus-purity, jit-purity,
 # dtype-hygiene, async-safety, broad-except, device-runtime purity.
@@ -96,6 +96,14 @@ perf-smoke:
 		--metric-tolerance kernel.fleet_block_prop_p95_ms=3.0 \
 		--metric-tolerance kernel.fleet_tx_prop_p50_ms=3.0 \
 		--metric-tolerance kernel.fleet_tx_prop_p95_ms=3.0
+
+# Snapshot sync gate (docs/SNAPSHOT.md): a build→serve→restore
+# round-trip on a two-node loopback swarm (byte-exact fingerprints,
+# generation rotation), then the snapshot_churn scenario — corruption,
+# mid-transfer partition, journaled failover resume, replay fallback —
+# run twice so the core fingerprint must reproduce byte-identically.
+snapshot-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.snapshot --check-determinism
 
 # Device-runtime gate (docs/DEVICE_RUNTIME.md): the fairness /
 # coalescing / degrade-flip / arm-failure test matrix, then the DR

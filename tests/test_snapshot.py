@@ -1,0 +1,407 @@
+"""Snapshot sync: builder determinism, generation rotation, serve
+endpoints, crash-safe resumable restore, integrity fallback, and the
+pg-backend payload parity oracle (docs/SNAPSHOT.md).
+
+The crash tests simulate kill -9 at the two nastiest points — between
+chunk commits and mid-chunk-write — by severing the source interface
+and by planting torn ``.part`` / tampered journal files, then assert
+the resume re-downloads ZERO already-verified chunks (the fake source
+counts every RPC) and still lands on the byte-exact fingerprint.
+"""
+
+import asyncio
+import json
+import os
+import shutil
+
+import pytest
+
+from upow_tpu.node.ratelimit import RateLimiter
+from upow_tpu.snapshot import builder, client, layout
+from upow_tpu.snapshot.client import SnapshotError
+from upow_tpu.state import ChainState
+from upow_tpu.state.pg import PgChainState
+from upow_tpu.state.pgdriver import MockPgDriver
+from upow_tpu.swarm import Swarm, run_scenario
+from upow_tpu.swarm.scenarios import (_sync_from, _wallet, core_ok,
+                                      deterministic_world)
+from upow_tpu.verify import BlockManager
+
+from test_wallet import easy_difficulty, make_actors, mine_block  # noqa: F401
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _populated_state(blocks=6):
+    state = ChainState()
+    manager = BlockManager(state, sig_backend="host")
+    _, addr = make_actors()["genesis"]
+    for _ in range(blocks):
+        await mine_block(manager, state, addr)
+    return state
+
+
+class DiskSource:
+    """Fake peer serving the published generation straight from disk,
+    counting every RPC; ``fail_after`` severs the link after that many
+    successful chunk fetches (the client sees a dead transport — the
+    same observable as the serving process being kill -9'd)."""
+
+    def __init__(self, root, fail_after=None):
+        self.base_url = "http://disk.local"
+        self.gen = layout.current_gen_dir(root)
+        self.manifest = layout.read_manifest(
+            os.path.join(self.gen, layout.MANIFEST_NAME))
+        self.fail_after = fail_after
+        self.manifest_rpcs = 0
+        self.chunk_rpcs = 0
+
+    async def snapshot_manifest(self):
+        self.manifest_rpcs += 1
+        return self.manifest
+
+    async def snapshot_chunk(self, i):
+        if self.fail_after is not None and \
+                self.chunk_rpcs >= self.fail_after:
+            raise ConnectionError("link severed")
+        self.chunk_rpcs += 1
+        with open(os.path.join(self.gen, layout.chunk_name(i)),
+                  "rb") as fh:
+            return fh.read()
+
+
+# -------------------------------------------------------------- builder ----
+
+def test_builder_manifest_is_deterministic(tmp_path):
+    async def main():
+        state = await _populated_state()
+        a = await builder.build_snapshot(state, str(tmp_path / "a"),
+                                         chunk_bytes=512)
+        b = await builder.build_snapshot(state, str(tmp_path / "b"),
+                                         chunk_bytes=512)
+        # same state -> byte-identical manifest (no timestamps, rows in
+        # canonical order) — this is what lets a joiner fail over to a
+        # second source and keep every verified chunk
+        assert layout.canonical_json(a) == layout.canonical_json(b)
+        assert len(a["chunks"]) >= 4
+        assert a["payload_bytes"] == sum(c["size"] for c in a["chunks"])
+        state.close()
+
+    run(main())
+
+
+def test_builder_empty_chain_yields_no_generation(tmp_path):
+    async def main():
+        state = ChainState()
+        assert await builder.build_snapshot(state, str(tmp_path)) is None
+        assert layout.current_manifest(str(tmp_path)) is None
+        state.close()
+
+    run(main())
+
+
+def test_generation_rotation_keeps_newest_two(tmp_path):
+    root = str(tmp_path)
+
+    def fake_gen(height):
+        name = layout.gen_name(height, f"{height:064x}")
+        os.makedirs(os.path.join(root, name))
+        layout.write_manifest(os.path.join(root, name,
+                                           layout.MANIFEST_NAME),
+                              {"anchor_height": height})
+        layout.publish_current(root, name)
+        return name
+
+    names = [fake_gen(h) for h in (10, 20, 30)]
+    os.makedirs(os.path.join(root, ".staging-leak"))
+    removed = layout.prune_generations(root, keep=2)
+    assert removed == 2  # oldest generation + the staging leak
+    assert layout.list_generations(root) == names[1:]
+    assert not os.path.exists(os.path.join(root, ".staging-leak"))
+    # CURRENT survives pruning even when it is the oldest generation
+    layout.publish_current(root, names[1])
+    fake_gen_dirs_before = layout.list_generations(root)
+    layout.prune_generations(root, keep=1)
+    assert names[1] in layout.list_generations(root)
+    assert len(fake_gen_dirs_before) == 2
+    # and a missing root never raises (startup housekeeping contract)
+    assert layout.prune_generations(str(tmp_path / "nope")) == 0
+
+
+def test_current_pointer_rejects_traversal(tmp_path):
+    root = str(tmp_path)
+    os.makedirs(os.path.join(root, "gen-000000001-aa"))
+    for evil in ("../escape", ".hidden", ""):
+        with open(os.path.join(root, layout.CURRENT_NAME), "w") as fh:
+            fh.write(evil + "\n")
+        assert layout.current_gen_dir(root) is None
+
+
+# ------------------------------------------------------------ rate limit ----
+
+def test_snapshot_chunk_indexes_share_one_ratelimit_bucket():
+    rl = RateLimiter()
+    # 20/second shared across the whole chunk space: distinct indexes
+    # must not multiply the budget
+    allowed = sum(rl.allow("1.2.3.4", f"/snapshot/chunk/{i}")
+                  for i in range(25))
+    assert allowed == 20
+    # the manifest budget is separate and unaffected
+    assert rl.allow("1.2.3.4", "/snapshot/manifest")
+    # and another IP gets its own chunk window
+    assert rl.allow("5.6.7.8", "/snapshot/chunk/0")
+
+
+# ------------------------------------------------------------- endpoints ----
+
+def test_snapshot_endpoints_serve_fresh_without_cache_bypass():
+    """Satellite regression: /snapshot/* must never be hot-cache
+    entries — a rebuild is visible on the very next request with NO
+    X-Upow-Cache-Bypass header."""
+    async def main():
+        swarm = await Swarm(1, seed=3).start(topology="isolated")
+        import tempfile
+
+        tmp = tempfile.mkdtemp(prefix="snapshot-endpoints-")
+        try:
+            _, addr = _wallet(3, "shared")
+            scfg = swarm.nodes[0].config.snapshot
+            scfg.dir = os.path.join(tmp, "n0")
+            scfg.chunk_bytes = 1024
+            scfg.blocks_tail = 4
+            # no generation published yet -> 404, not an empty cache hit
+            doc = await swarm.get(0, "snapshot/manifest")
+            assert doc == {"ok": False, "error": "no snapshot available"}
+            for _ in range(4):
+                assert (await swarm.mine(0, addr, push_to=[0]))["ok"]
+            m1 = await swarm.nodes[0].build_snapshot()
+            doc = await swarm.get(0, "snapshot/manifest")
+            assert doc["ok"] and doc["result"] == m1
+            chunk = await swarm.get(0, "snapshot/chunk/0")
+            data = bytes.fromhex(chunk["result"]["data"])
+            assert layout.sha256_hex(data) == m1["chunks"][0]["sha256"]
+            # hardened params: non-integer and out-of-range indexes
+            assert not (await swarm.get(0, "snapshot/chunk/zzz"))["ok"]
+            bad = await swarm.get(0, f"snapshot/chunk/{len(m1['chunks'])}")
+            assert bad == {"ok": False, "error": "no such chunk"}
+            # rebuild at a later height: the next manifest read (same
+            # driver, no bypass header) must see the new anchor
+            assert (await swarm.mine(0, addr, push_to=[0]))["ok"]
+            m2 = await swarm.nodes[0].build_snapshot()
+            assert m2["anchor_height"] == m1["anchor_height"] + 1
+            doc = await swarm.get(0, "snapshot/manifest")
+            assert doc["result"]["anchor_height"] == m2["anchor_height"]
+        finally:
+            await swarm.close()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    with deterministic_world(3):
+        run(main())
+
+
+# ------------------------------------------------------- crash + resume ----
+
+def test_kill_between_chunks_resumes_with_zero_redownloads(tmp_path):
+    async def main():
+        state = await _populated_state()
+        root = str(tmp_path / "server")
+        await builder.build_snapshot(state, root, chunk_bytes=512)
+        total = len(layout.current_manifest(root)["chunks"])
+        assert total >= 5
+
+        # pass 1: the link dies after 3 committed chunks — the same
+        # journal state a kill -9 between chunks 3 and 4 leaves behind
+        joiner = ChainState()
+        jroot = str(tmp_path / "joiner")
+        with pytest.raises(SnapshotError) as e:
+            await client.bootstrap_from_snapshot(
+                joiner, [DiskSource(root, fail_after=3)], jroot)
+        assert e.value.reason == "sources_exhausted"
+
+        # pass 2 (the restarted process): every journaled chunk is
+        # reused — the source serves exactly the missing remainder
+        src = DiskSource(root)
+        res = await client.bootstrap_from_snapshot(joiner, [src], jroot)
+        assert res["chunks_reused"] == 3
+        assert src.chunk_rpcs == total - 3
+        assert await joiner.get_unspent_outputs_hash() == \
+            await state.get_unspent_outputs_hash()
+        assert await joiner.get_full_state_hash() == \
+            await state.get_full_state_hash()
+        # the journal is gone after a successful restore
+        assert not os.listdir(os.path.join(jroot, "restore"))
+        state.close()
+        joiner.close()
+
+    run(main())
+
+
+def test_kill_mid_chunk_write_ignores_torn_part_file(tmp_path):
+    async def main():
+        state = await _populated_state()
+        root = str(tmp_path / "server")
+        await builder.build_snapshot(state, root, chunk_bytes=512)
+        manifest = layout.current_manifest(root)
+        total = len(manifest["chunks"])
+
+        joiner = ChainState()
+        jroot = str(tmp_path / "joiner")
+        with pytest.raises(SnapshotError):
+            await client.bootstrap_from_snapshot(
+                joiner, [DiskSource(root, fail_after=2)], jroot)
+        jdir = os.path.join(jroot, "restore",
+                            manifest["payload_sha256"][:16])
+        # kill -9 mid-write leaves a torn .part (never renamed); plant
+        # one exactly as the crash would
+        with open(os.path.join(jdir, layout.chunk_name(2) + ".part"),
+                  "wb") as fh:
+            fh.write(b"torn")
+
+        src = DiskSource(root)
+        res = await client.bootstrap_from_snapshot(joiner, [src], jroot)
+        assert res["chunks_reused"] == 2
+        assert src.chunk_rpcs == total - 2  # the .part bought nothing
+        assert await joiner.get_full_state_hash() == \
+            await state.get_full_state_hash()
+        state.close()
+        joiner.close()
+
+    run(main())
+
+
+def test_tampered_journal_chunk_is_refetched_not_trusted(tmp_path):
+    async def main():
+        state = await _populated_state()
+        root = str(tmp_path / "server")
+        await builder.build_snapshot(state, root, chunk_bytes=512)
+        manifest = layout.current_manifest(root)
+        total = len(manifest["chunks"])
+
+        joiner = ChainState()
+        jroot = str(tmp_path / "joiner")
+        with pytest.raises(SnapshotError):
+            await client.bootstrap_from_snapshot(
+                joiner, [DiskSource(root, fail_after=3)], jroot)
+        jdir = os.path.join(jroot, "restore",
+                            manifest["payload_sha256"][:16])
+        with open(os.path.join(jdir, layout.chunk_name(1)), "wb") as fh:
+            fh.write(b"\x00" * 64)  # bit-rot / tamper on the journal
+
+        src = DiskSource(root)
+        res = await client.bootstrap_from_snapshot(joiner, [src], jroot)
+        # chunks 0 and 2 survive re-verification; chunk 1 is re-fetched
+        assert res["chunks_reused"] == 2
+        assert src.chunk_rpcs == total - 2
+        assert await joiner.get_full_state_hash() == \
+            await state.get_full_state_hash()
+        state.close()
+        joiner.close()
+
+    run(main())
+
+
+# ------------------------------------------------------------- integrity ----
+
+def test_poisoned_fingerprint_never_reaches_the_database(tmp_path):
+    async def main():
+        state = await _populated_state()
+        root = str(tmp_path / "server")
+        await builder.build_snapshot(state, root, chunk_bytes=512)
+        src = DiskSource(root)
+        src.manifest = dict(src.manifest,
+                            utxo_fingerprint="f" * 64)
+
+        joiner = ChainState()
+        with pytest.raises(SnapshotError) as e:
+            await client.bootstrap_from_snapshot(
+                joiner, [src], str(tmp_path / "joiner"))
+        assert e.value.reason == "fingerprint_mismatch"
+        # nothing was written: the joiner is still a blank chain
+        assert await joiner.get_last_block() is None
+        state.close()
+        joiner.close()
+
+    run(main())
+
+
+def test_malformed_manifest_skips_to_next_source(tmp_path):
+    async def main():
+        state = await _populated_state(blocks=3)
+        root = str(tmp_path / "server")
+        await builder.build_snapshot(state, root, chunk_bytes=512)
+        bad = DiskSource(root)
+        bad.manifest = {"version": 99}
+        good = DiskSource(root)
+        joiner = ChainState()
+        res = await client.bootstrap_from_snapshot(
+            joiner, [bad, good], str(tmp_path / "joiner"))
+        assert res["source"] == good.base_url
+        assert await joiner.get_full_state_hash() == \
+            await state.get_full_state_hash()
+        state.close()
+        joiner.close()
+
+    run(main())
+
+
+# ------------------------------------------------- snapshot_recommended ----
+
+def test_sync_far_behind_emits_snapshot_recommended():
+    async def main():
+        swarm = await Swarm(2, seed=5, reorg_window=4).start(
+            topology="isolated")
+        try:
+            _, addr = _wallet(5, "shared")
+            for _ in range(8):
+                assert (await swarm.mine(0, addr, push_to=[0]))["ok"]
+            assert (await _sync_from(swarm, 1, winner=0))["ok"]
+            doc = await swarm.get(1, "debug/events",
+                                  params={"kind": "snapshot_recommended"})
+            events = doc["result"]
+            assert events, "no snapshot_recommended event on /debug/events"
+            ev = events[-1]
+            assert ev["lag"] > 4 and ev["remote_height"] == 8
+        finally:
+            await swarm.close()
+
+    with deterministic_world(5):
+        run(main())
+
+
+# ------------------------------------------------------------ pg parity ----
+
+def test_pg_backend_payload_parity(tmp_path):
+    """The payload is backend-neutral: a chain exported from sqlite,
+    restored into the pg backend (mock driver executes the real pg
+    SQL), must re-export the byte-identical payload and report the
+    same fingerprints."""
+    async def main():
+        state = await _populated_state()
+        payload, _ = await builder.serialize_payload(state, blocks_tail=8)
+        tables, txs, blocks = client.parse_payload(payload)
+
+        pg = PgChainState(driver=MockPgDriver())
+        await pg.restore_snapshot(tables, txs, blocks)
+        assert await pg.get_unspent_outputs_hash() == \
+            await state.get_unspent_outputs_hash()
+        assert await pg.get_full_state_hash() == \
+            await state.get_full_state_hash()
+        pg_payload, _ = await builder.serialize_payload(pg, blocks_tail=8)
+        assert pg_payload == payload
+        state.close()
+
+    run(main())
+
+
+# -------------------------------------------------------------- scenario ----
+
+def test_snapshot_churn_scenario_green_and_deterministic():
+    a = run_scenario("snapshot_churn", seed=7)
+    assert core_ok(a["core"]), {
+        k: v for k, v in a["core"].items()
+        if isinstance(v, bool) and not v}
+    assert a["observed"]["snapshot_rpcs"] < a["observed"]["replay_rpcs"]
+    b = run_scenario("snapshot_churn", seed=7)
+    assert a["fingerprint"] == b["fingerprint"]

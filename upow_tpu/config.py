@@ -240,6 +240,32 @@ class CacheConfig:
 
 
 @dataclass
+class SnapshotConfig:
+    """Block-hash-anchored UTXO snapshot subsystem (upow_tpu/snapshot/,
+    docs/SNAPSHOT.md).  Operational only: a snapshot-restored node and a
+    full-replay node end on byte-identical UTXO fingerprints, so none of
+    these knobs touch consensus.  All overridable as
+    ``UPOW_SNAPSHOT_<FIELD>``."""
+
+    dir: str = ""                   # snapshot root directory; '' disables
+                                    # both building and serving
+    chunk_bytes: int = 1 << 20      # fixed chunk size the payload is
+                                    # split into (each chunk sha256'd
+                                    # into the manifest)
+    blocks_tail: int = 64           # recent block rows carried in the
+                                    # payload so a restored node has a
+                                    # tip + fork-detection history
+                                    # (should be >= sync_reorg_window in
+                                    # production; swarm uses a tiny
+                                    # window so the default covers it)
+    keep: int = 2                   # on-disk generations retained; older
+                                    # ones and stale staging dirs are
+                                    # pruned (never raising)
+    chunk_retries: int = 2          # per-chunk integrity retries against
+                                    # ONE source before failing over
+
+
+@dataclass
 class NodeConfig:
     host: str = "0.0.0.0"
     port: int = 3006                # reference run_node.py port
@@ -360,6 +386,7 @@ class Config:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     cache: CacheConfig = field(default_factory=CacheConfig)
+    snapshot: SnapshotConfig = field(default_factory=SnapshotConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     profile: ProfilingConfig = field(default_factory=ProfilingConfig)
 
@@ -402,8 +429,8 @@ def _merge_dict(cfg: Config, data: dict) -> Config:
 
 def _merge_env(cfg: Config) -> Config:
     for section in ("device", "device_runtime", "node", "ws", "miner",
-                    "log", "resilience", "mempool", "cache", "telemetry",
-                    "profile"):
+                    "log", "resilience", "mempool", "cache", "snapshot",
+                    "telemetry", "profile"):
         _apply_env_fields(getattr(cfg, section), section)
     return cfg
 

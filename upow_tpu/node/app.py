@@ -17,6 +17,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import json
+import os
 import random
 import re
 import sys
@@ -225,6 +226,16 @@ class Node:
         # so peer logic — breakers, retries, trace headers — runs
         # unmodified over a simulated network (upow_tpu/swarm/).
         self.iface_factory = NodeInterface
+        # snapshot bootstrap progress (upow_tpu/snapshot/client.py
+        # mutates it in place; /metrics exports it) + startup
+        # housekeeping: bound on-disk generations and sweep staging
+        # dirs a crashed builder left behind (never raises)
+        self.snapshot_restore: dict = {}
+        if self.config.snapshot.dir:
+            from ..snapshot import layout as snapshot_layout
+
+            snapshot_layout.prune_generations(self.config.snapshot.dir,
+                                              keep=self.config.snapshot.keep)
         self.app = self._build_app()
 
     # ----------------------------------------------------------- plumbing --
@@ -700,6 +711,27 @@ class Node:
                 "Peers messaged within the activity window")
         e.gauge("node_syncing", int(bool(self.is_syncing)),
                 "1 while a chain sync is in progress")
+        snapshot_gen = self._snapshot_gen()
+        if snapshot_gen is not None:
+            m = snapshot_gen[1]
+            e.gauge("snapshot_published_height", m["anchor_height"],
+                    "Anchor height of the published snapshot generation")
+            e.gauge("snapshot_published_chunks", len(m["chunks"]),
+                    "Chunks in the published snapshot generation")
+            e.gauge("snapshot_published_bytes", m["payload_bytes"],
+                    "Payload bytes of the published snapshot generation")
+        if self.snapshot_restore:
+            sr = self.snapshot_restore
+            e.gauge("snapshot_restore_chunks_total",
+                    sr.get("total", 0),
+                    "Chunks the in-progress/last snapshot restore needs")
+            e.gauge("snapshot_restore_chunks_verified",
+                    sr.get("verified", 0),
+                    "Chunks verified by the current restore pass")
+            e.gauge("snapshot_restore_chunks_reused",
+                    sr.get("reused", 0),
+                    "Verified chunks reused from the journal (not"
+                    " re-downloaded) by the current restore pass")
         sig = sig_verdict_stats()
         e.gauge("sig_cache_entries", sig["size"],
                 "Entries in the signature-verdict cache")
@@ -933,6 +965,146 @@ class Node:
                 status=400)
         return web.json_response({"ok": "error" not in result,
                                   "result": result})
+
+    # ------------------------------------------------------- snapshots ---
+    # Serving reads ONLY the published on-disk generation (manifest +
+    # chunk files) — never the database: a restoring peer hammering
+    # /snapshot/chunk must not contend with block accept.  Deliberately
+    # NOT routed through _cached (tests pin this): the chunk bytes are
+    # already static files, and a cache-bypass header must never be
+    # needed to get authoritative snapshot bytes.
+
+    def _snapshot_gen(self):
+        """(gen dir, manifest) of the published generation, or None."""
+        from ..snapshot import layout as snapshot_layout
+
+        root = self.config.snapshot.dir
+        if not root:
+            return None
+        gen = snapshot_layout.current_gen_dir(root)
+        if gen is None:
+            return None
+        manifest = snapshot_layout.read_manifest(
+            os.path.join(gen, snapshot_layout.MANIFEST_NAME))
+        if manifest is None:
+            return None
+        return gen, manifest
+
+    @staticmethod
+    async def _snapshot_serve_fault(key: str):
+        """Fire the ``snapshot.serve`` chaos site; a 503 keeps an
+        injected serve fault inside ordinary peer-error handling."""
+        injector = faultinject.get_injector()
+        if injector is not None:
+            try:
+                await injector.fire("snapshot.serve", key)
+            except faultinject.FaultInjected:
+                return web.json_response(
+                    {"ok": False,
+                     "error": "snapshot temporarily unavailable"},
+                    status=503)
+        return None
+
+    async def h_snapshot_manifest(self,
+                                  request: web.Request) -> web.Response:
+        fault = await self._snapshot_serve_fault("manifest")
+        if fault is not None:
+            return fault
+        gen = self._snapshot_gen()
+        if gen is None:
+            return web.json_response(
+                {"ok": False, "error": "no snapshot available"},
+                status=404)
+        trace.inc("snapshot.manifest_served")
+        return web.json_response({"ok": True, "result": gen[1]})
+
+    async def h_snapshot_chunk(self, request: web.Request) -> web.Response:
+        from ..snapshot import layout as snapshot_layout
+
+        try:
+            i = int(request.match_info["i"])
+        except (KeyError, ValueError):
+            return web.json_response(
+                {"ok": False, "error": "chunk index must be an integer"},
+                status=422)
+        fault = await self._snapshot_serve_fault(f"chunk/{i}")
+        if fault is not None:
+            return fault
+        gen = self._snapshot_gen()
+        if gen is None or not 0 <= i < len(gen[1]["chunks"]):
+            return web.json_response(
+                {"ok": False, "error": "no such chunk"}, status=404)
+        try:
+            with open(os.path.join(gen[0], snapshot_layout.chunk_name(i)),
+                      "rb") as fh:
+                data = fh.read()
+        except OSError:
+            return web.json_response(
+                {"ok": False, "error": "no such chunk"}, status=404)
+        injector = faultinject.get_injector()
+        if injector is not None:  # corrupt-kind rules rewrite payloads
+            data = injector.fire_mutate("snapshot.serve", f"chunk/{i}",
+                                        data)
+        trace.inc("snapshot.chunks_served")
+        return web.json_response(
+            {"ok": True, "result": {"i": i, "data": data.hex()}})
+
+    async def build_snapshot(self):
+        """Build + publish a generation under config.snapshot.dir
+        (None when the subsystem is disabled or the chain is empty)."""
+        scfg = self.config.snapshot
+        if not scfg.dir:
+            return None
+        from ..snapshot.builder import build_snapshot as _build
+
+        return await _build(self.state, scfg.dir,
+                            chunk_bytes=scfg.chunk_bytes,
+                            blocks_tail=scfg.blocks_tail, keep=scfg.keep)
+
+    async def bootstrap_from_snapshot(self, sources=None) -> dict:
+        """Onboard this node from a peer snapshot, falling back to full
+        block replay (sync_blockchain) with a structured reason when
+        snapshot restore cannot complete.  ``sources`` overrides peer
+        selection; by default peers are ordered by the same breaker/
+        health rank sync_blockchain uses."""
+        from ..snapshot.client import (SnapshotError,
+                                       bootstrap_from_snapshot)
+
+        scfg = self.config.snapshot
+        if sources is None:
+            sources = self.peers.ranked(self.peers.recent_nodes())
+        reason = detail = ""
+        if not scfg.dir:
+            reason = "snapshot_disabled"
+        elif not sources:
+            reason = "no_sources"
+        else:
+            ifaces = [self.iface_factory(url, self.config.node,
+                                         session=self._session(),
+                                         resilience=self.resilience)
+                      for url in sources]
+            try:
+                result = await bootstrap_from_snapshot(
+                    self.state, ifaces, scfg.dir,
+                    chunk_retries=scfg.chunk_retries,
+                    progress=self.snapshot_restore)
+                # restored state invalidates everything derived from it
+                self.hotcache.bump("snapshot_restore")
+                self.manager.invalidate_difficulty()
+                return {"ok": True, **result}
+            except SnapshotError as e:
+                reason, detail = e.reason, e.detail
+            finally:
+                for iface in ifaces:
+                    await iface.close()
+        trace.inc("snapshot.fallbacks")
+        telemetry.event("snapshot_fallback", reason=reason,
+                        detail=detail or None)
+        log.warning("snapshot bootstrap failed (%s); falling back to"
+                    " full replay", reason)
+        sync = await self.sync_blockchain()
+        return {"ok": bool(sync.get("ok")), "method": "replay_fallback",
+                "reason": reason, "sync": sync}
 
     async def h_push_tx(self, request: web.Request) -> web.Response:
         if self.is_syncing:
@@ -1533,6 +1705,25 @@ class Node:
         try:
             _, last_block = await self.manager.calculate_difficulty()
             starting_from = i = await self.state.get_next_block_id()
+            # advisory probe (docs/SNAPSHOT.md): when the peer's tip is
+            # further ahead than the reorg window can ever bridge
+            # block-by-block cheaply, surface a structured hint that
+            # snapshot onboarding would be the better path.  Best
+            # effort — a probe failure must not abort the sync.
+            try:
+                info = (await iface.get("get_mining_info")).get(
+                    "result") or {}
+                remote_height = int(
+                    (info.get("last_block") or {}).get("id") or 0)
+            except Exception as e:
+                log.debug("tip probe of %s failed: %s", node_url, e)
+                remote_height = 0
+            if remote_height - (i - 1) > cfg.sync_reorg_window:
+                trace.inc("snapshot_recommended")
+                telemetry.event(
+                    "snapshot_recommended", peer=node_url,
+                    local_height=i - 1, remote_height=remote_height,
+                    lag=remote_height - (i - 1))
             local_cache = None
             last_common_block = 0
             if last_block and last_block.get("id", 0) > cfg.sync_reorg_window:
@@ -1871,9 +2062,11 @@ class Node:
             ("/get_blocks_details", self.h_get_blocks_details),
             ("/dobby_info", self.h_dobby_info),
             ("/get_supply_info", self.h_get_supply_info),
+            ("/snapshot/manifest", self.h_snapshot_manifest),
             ("/metrics", self.h_metrics),
         ]:
             r.add_get(path, handler)
+        r.add_get("/snapshot/chunk/{i}", self.h_snapshot_chunk)
         if self.config.telemetry.debug_endpoints:
             r.add_get("/debug/traces", self.h_debug_traces)
             r.add_get("/debug/events", self.h_debug_events)

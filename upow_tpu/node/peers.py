@@ -226,10 +226,16 @@ class NodeInterface:
                 raise ValueError("response too large")
         return json.loads(buf or b"{}")
 
-    async def _resilient(self, attempt, label: str):
+    async def _resilient(self, attempt, label: str,
+                         site: Optional[str] = None,
+                         site_key: Optional[str] = None):
         """Run one RPC attempt factory under the breaker → fault-injection
         → retry stack.  Without a ResilienceContext this is a transparent
-        single attempt (standalone clients keep the original behaviour)."""
+        single attempt (standalone clients keep the original behaviour).
+        ``site`` renames the fault-injection site away from the default
+        ``rpc.<label>`` (the snapshot bootstrap fires ``snapshot.fetch``
+        so chaos specs can target restore traffic without touching the
+        ordinary RPC plane)."""
         ctx = self._resilience
         if ctx is None:
             return await attempt()
@@ -241,7 +247,8 @@ class NodeInterface:
         async def guarded():
             injector = faultinject.get_injector()
             if injector is not None:
-                await injector.fire(f"rpc.{label}", self.base_url)
+                await injector.fire(site or f"rpc.{label}",
+                                    site_key or self.base_url)
             return await attempt()
 
         def on_retry(exc, retry_no):
@@ -292,7 +299,8 @@ class NodeInterface:
         return headers
 
     async def get(self, path: str, params: Optional[dict] = None,
-                  sender_node: str = "") -> dict:
+                  sender_node: str = "", site: Optional[str] = None,
+                  site_key: Optional[str] = None) -> dict:
         headers = self._rpc_headers(sender_node)
 
         async def attempt() -> dict:
@@ -302,7 +310,8 @@ class NodeInterface:
                                    headers=headers) as resp:
                 return await self._read_capped(resp)
 
-        return await self._resilient(attempt, path)
+        return await self._resilient(attempt, path, site=site,
+                                     site_key=site_key)
 
     @staticmethod
     def _result(res: dict):
@@ -324,3 +333,20 @@ class NodeInterface:
 
     async def get_nodes(self) -> list:
         return self._result(await self.get("get_nodes"))
+
+    # ------------------------------------------------------- snapshots ----
+    # Both run under the ordinary breaker/retry stack but fire the
+    # dedicated ``snapshot.fetch`` site (keyed per document) so a chaos
+    # spec can fault restore traffic — or one specific chunk — without
+    # touching the rpc.* plane.
+
+    async def snapshot_manifest(self) -> dict:
+        return self._result(await self.get(
+            "snapshot/manifest", site="snapshot.fetch",
+            site_key=f"{self.base_url}#manifest"))
+
+    async def snapshot_chunk(self, i: int) -> bytes:
+        doc = self._result(await self.get(
+            f"snapshot/chunk/{int(i)}", site="snapshot.fetch",
+            site_key=f"{self.base_url}#chunk/{int(i)}"))
+        return bytes.fromhex(doc["data"])

@@ -27,6 +27,12 @@ DEFAULT_LIMITS = {
     "/get_blocks_details": "10/minute",
     "/dobby_info": "20/minute",
     "/get_supply_info": "20/minute",
+    # snapshot sync surface (docs/SNAPSHOT.md): served straight from
+    # on-disk chunk files, so the budgets are about network fairness,
+    # not database load — a restoring peer pulls many chunks back to
+    # back, a manifest poll is one small JSON read
+    "/snapshot/manifest": "30/minute",
+    "/snapshot/chunk": "20/second",
 }
 
 _PERIODS = {"second": 1.0, "minute": 60.0, "hour": 3600.0}
@@ -48,9 +54,22 @@ class RateLimiter:
         self._hits: Dict[Tuple[str, str], Deque[float]] = {}
         self._calls = 0
 
+    def _bucket(self, endpoint: str) -> str:
+        """Collapse a dynamic-suffix path onto its registered limit:
+        ``/snapshot/chunk/17`` shares ``/snapshot/chunk``'s window (one
+        budget for the whole chunk space — per-index windows would let
+        a scanner multiply its allowance by the chunk count)."""
+        probe = endpoint
+        while probe and probe not in self.limits:
+            probe = probe.rsplit("/", 1)[0]
+        return probe or endpoint
+
     def allow(self, ip: str, endpoint: str) -> bool:
         """True if this request is within the endpoint's budget."""
-        if not self.enabled or endpoint not in self.limits:
+        if not self.enabled:
+            return True
+        endpoint = self._bucket(endpoint)
+        if endpoint not in self.limits:
             return True
         count, period = self.limits[endpoint]
         now = time.monotonic()

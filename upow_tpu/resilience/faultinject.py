@@ -17,17 +17,25 @@ Registered sites: ``rpc.<path>`` (peers.py, per peer RPC attempt),
 (txverify.py), ``device.runtime`` (device/runtime.py — fires once per
 drained dispatch with key ``"sig:<sources>"`` for coalesced signature
 groups or ``"call:<kernel>"`` for single-kernel calls, so ``key=`` can
-target one subsystem's traffic), and ``swarm.link`` (swarm/links.py —
+target one subsystem's traffic), ``swarm.link`` (swarm/links.py —
 fires once per simulated transfer with key ``"src->dst"``, so ``key=``
-can target one direction of one link).
+can target one direction of one link), ``snapshot.serve`` (node/app.py
+— per /snapshot/manifest and /snapshot/chunk response, key
+``"manifest"`` or ``"chunk/<i>"``; the ``corrupt`` kind flips served
+chunk bytes instead of erroring) and ``snapshot.fetch``
+(snapshot/client.py, per bootstrap RPC attempt inside the retry
+policy, key ``"<source url>#manifest"`` or ``"<source url>#chunk/<i>"``).
 
 Sites are prefix-matched (``rpc`` matches ``rpc.get_blocks``); ``key``
 substring-filters the per-call key (usually the peer URL).  ``kind`` is
 ``error`` (raise :class:`FaultInjected`), ``latency`` (sleep ``delay``
-then proceed) or ``hang`` (sleep ``delay``, default far beyond any
-deadline, then raise).  ``p`` draws from ONE seeded ``random.Random``
-so a fixed ``faults_seed`` replays the exact fault schedule; ``times``
-caps how often a rule fires (-1 = unlimited).
+then proceed), ``hang`` (sleep ``delay``, default far beyond any
+deadline, then raise) or ``corrupt`` (only consulted by sites that
+pass payload bytes through :meth:`FaultInjector.fire_mutate`: the
+payload comes back bit-flipped, modelling a peer serving damaged data
+that only an integrity check can catch).  ``p`` draws from ONE seeded
+``random.Random`` so a fixed ``faults_seed`` replays the exact fault
+schedule; ``times`` caps how often a rule fires (-1 = unlimited).
 
 Production stance: the hooks in peers.py / hub.py / txverify.py call
 :func:`get_injector` which returns ``None`` unless :func:`install` ran
@@ -47,7 +55,11 @@ from ..logger import get_logger
 
 log = get_logger("faultinject")
 
-KINDS = ("error", "latency", "hang")
+KINDS = ("error", "latency", "hang", "corrupt")
+#: Kinds the control-flow injection points (fire / fire_sync) act on —
+#: a ``corrupt`` rule must never raise there, it only rewrites payloads
+#: at fire_mutate sites.
+_FLOW_KINDS = ("error", "latency", "hang")
 _HANG_DEFAULT = 3600.0  # beyond any sane deadline; boxed/wait_for food
 
 
@@ -119,9 +131,12 @@ class FaultInjector:
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
 
-    def _pick(self, site: str, key: str) -> Optional[Fault]:
+    def _pick(self, site: str, key: str,
+              kinds=_FLOW_KINDS) -> Optional[Fault]:
         with self._lock:
             for fault in self.faults:
+                if fault.kind not in kinds:
+                    continue
                 if fault.matches(site, key) and \
                         (fault.p >= 1.0 or self._rng.random() < fault.p):
                     fault.fired += 1
@@ -156,6 +171,21 @@ class FaultInjector:
         if fault.kind == "hang":
             time.sleep(fault.delay)
         raise FaultInjected(site, key)
+
+    def fire_mutate(self, site: str, key: str, data: bytes) -> bytes:
+        """Payload injection point: a matching ``corrupt`` rule returns
+        the data with one deterministically-chosen byte flipped (seeded
+        RNG picks the offset), so downstream integrity checks — not
+        transport error handling — are what must catch it."""
+        fault = self._pick(site, key, kinds=("corrupt",))
+        if fault is None or not data:
+            return data
+        self._count(fault, site, key)
+        with self._lock:
+            offset = self._rng.randrange(len(data))
+        out = bytearray(data)
+        out[offset] ^= 0xFF
+        return bytes(out)
 
     def _count(self, fault: Fault, site: str, key: str) -> None:
         from .. import trace

@@ -1,0 +1,293 @@
+"""Resumable snapshot bootstrap (the restore half of docs/SNAPSHOT.md).
+
+Trust model: the serving peer is NOT trusted.  Every chunk is verified
+against the manifest's sha256 before it is journaled; the assembled
+payload is verified against ``payload_sha256``; and the UTXO + full
+state fingerprints are recomputed CLIENT-SIDE from the parsed rows and
+compared to the manifest's anchors before a single database write —
+the database only ever ingests a payload that already proved itself.
+After the (single-transaction) restore the database's own fingerprints
+are cross-checked once more against the manifest.
+
+Crash model: the journal dir is keyed by the manifest's payload hash;
+a chunk becomes durable only via write-to-``.part`` + fsync +
+``os.replace`` onto ``chunk-NNNNNN.bin`` — the rename IS the commit.
+kill -9 between chunks resumes from the last verified chunk with zero
+re-downloads; kill -9 mid-chunk-write leaves a ``.part`` that is
+simply overwritten.  Journaled chunks are re-verified from disk on
+resume, so torn or tampered journal bytes are re-fetched, never
+trusted.
+
+Failure ladder: per-chunk integrity retries against one source are
+capped (``SnapshotConfig.chunk_retries``), then the next health-ranked
+source is tried (verified chunks carry over when it serves the same
+payload); when sources or integrity run out, :class:`SnapshotError`
+carries a structured reason and the caller (node/app.py) falls back to
+full block replay — a bad snapshot peer must never break the join.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+from typing import Dict, List, Optional
+
+from .. import telemetry, trace
+from ..logger import get_logger
+from . import layout
+from .builder import SNAPSHOT_TABLES
+
+log = get_logger("snapshot")
+
+
+class SnapshotError(Exception):
+    """Restore could not complete; ``reason`` is the structured code
+    surfaced in the ``snapshot_fallback`` telemetry event."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"{reason}: {detail}" if detail else reason)
+        self.reason = reason
+        self.detail = detail
+
+
+def _manifest_ok(m: dict) -> bool:
+    try:
+        return (m["version"] == layout.MANIFEST_VERSION
+                and isinstance(m["anchor_hash"], str)
+                and int(m["anchor_height"]) > 0
+                and isinstance(m["chunks"], list) and m["chunks"]
+                and all(isinstance(c["sha256"], str)
+                        and int(c["i"]) == i
+                        for i, c in enumerate(m["chunks"])))
+    except (KeyError, TypeError, ValueError):
+        return False
+
+
+def parse_payload(payload: bytes) -> tuple:
+    """payload bytes -> (tables dict, tx rows, block rows); raises
+    SnapshotError on any malformed line."""
+    tables: Dict[str, List[list]] = {t: [] for t in SNAPSHOT_TABLES}
+    txs: List[list] = []
+    blocks: List[list] = []
+    for ln, raw in enumerate(payload.splitlines()):
+        try:
+            doc = json.loads(raw)
+            t, r = doc["t"], doc["r"]
+        except (ValueError, KeyError, TypeError):
+            raise SnapshotError("payload_malformed", f"line {ln}")
+        if t in tables:
+            tables[t].append(r)
+        elif t == "tx":
+            txs.append(r)
+        elif t == "block":
+            blocks.append(r)
+        else:
+            raise SnapshotError("payload_malformed",
+                                f"line {ln}: unknown section {t!r}")
+    return tables, txs, blocks
+
+
+def fingerprint_rows(rows: List[list]) -> str:
+    """The table fingerprint recomputed from payload rows — must equal
+    the backend's get_table_outpoints_hash (sha256 over the sorted
+    outpoint concatenation)."""
+    h = hashlib.sha256()
+    for r in sorted(rows, key=lambda r: (r[0], r[1])):
+        h.update(f"{r[0]}{r[1]}".encode())
+    return h.hexdigest()
+
+
+def full_fingerprint(tables: Dict[str, List[list]]) -> str:
+    h = hashlib.sha256()
+    for table in SNAPSHOT_TABLES:
+        h.update(table.encode())
+        h.update(fingerprint_rows(tables.get(table, [])).encode())
+    return h.hexdigest()
+
+
+class _Journal:
+    """Verified-chunk journal for one payload identity."""
+
+    def __init__(self, root: str, manifest: dict):
+        self.manifest = manifest
+        self.dir = os.path.join(root, "restore",
+                                manifest["payload_sha256"][:16])
+        os.makedirs(self.dir, exist_ok=True)
+        layout.write_manifest(os.path.join(self.dir, layout.MANIFEST_NAME),
+                              manifest)
+
+    def chunk_path(self, i: int) -> str:
+        return os.path.join(self.dir, layout.chunk_name(i))
+
+    def have_verified(self, i: int) -> bool:
+        """True when chunk i is journaled AND its bytes still match the
+        manifest (re-verified from disk — a torn or tampered journal
+        entry is treated as absent)."""
+        try:
+            with open(self.chunk_path(i), "rb") as fh:
+                data = fh.read()
+        except OSError:
+            return False
+        return layout.sha256_hex(data) == \
+            self.manifest["chunks"][i]["sha256"]
+
+    def commit_chunk(self, i: int, data: bytes) -> None:
+        """Durable-then-rename: the ``os.replace`` is the commit point;
+        a crash before it leaves only a ``.part`` the resume ignores."""
+        part = self.chunk_path(i) + ".part"
+        with open(part, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(part, self.chunk_path(i))
+
+    def assemble(self) -> bytes:
+        return b"".join(
+            open(self.chunk_path(i), "rb").read()
+            for i in range(len(self.manifest["chunks"])))
+
+    def destroy(self) -> None:
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+async def bootstrap_from_snapshot(state, sources, root: str,
+                                  chunk_retries: int = 2,
+                                  progress: Optional[dict] = None) -> dict:
+    """Restore ``state`` from the first healthy source in ``sources``
+    (NodeInterface instances, already health-ranked by the caller).
+
+    Returns a result dict (method/height/anchor/chunks/chunks_reused/
+    source/rpcs); raises :class:`SnapshotError` with a structured
+    reason when every source is exhausted or integrity fails — the
+    caller owns the replay fallback.
+    """
+    if not sources:
+        raise SnapshotError("no_sources")
+    progress = progress if progress is not None else {}
+    progress.update(phase="manifest", verified=0, reused=0, total=0,
+                    source="")
+    rpcs = 0
+    last_error = ""
+    journal = None
+    for iface in sources:
+        src = iface.base_url
+        try:
+            rpcs += 1
+            manifest = await iface.snapshot_manifest()
+        except Exception as e:
+            last_error = f"{src}: manifest: {e}"
+            log.debug("snapshot source %s failed at manifest: %s", src, e)
+            telemetry.event("snapshot_source_failed", source=src,
+                            stage="manifest", error=str(e))
+            continue
+        if not isinstance(manifest, dict) or not _manifest_ok(manifest):
+            last_error = f"{src}: manifest malformed"
+            telemetry.event("snapshot_source_failed", source=src,
+                            stage="manifest", error="malformed")
+            continue
+        if journal is None or \
+                journal.manifest["payload_sha256"] != \
+                manifest["payload_sha256"]:
+            # new payload identity -> new journal; identical payload
+            # from a failover source reuses every verified chunk
+            journal = _Journal(root, manifest)
+        chunks = journal.manifest["chunks"]
+        # per-pass counters: on failover, "reused" counts the verified
+        # chunks the new pass inherited (i.e. not re-downloaded)
+        progress.update(phase="chunks", total=len(chunks), source=src,
+                        verified=0, reused=0,
+                        height=journal.manifest["anchor_height"])
+        telemetry.event("snapshot_restore_start", source=src,
+                        height=journal.manifest["anchor_height"],
+                        chunks=len(chunks))
+        source_dead = False
+        for i in range(len(chunks)):
+            if journal.have_verified(i):
+                progress["verified"] = progress.get("verified", 0) + 1
+                progress["reused"] = progress.get("reused", 0) + 1
+                trace.inc("snapshot.chunks_reused")
+                continue
+            ok = False
+            for attempt in range(max(1, chunk_retries + 1)):
+                try:
+                    rpcs += 1
+                    data = await iface.snapshot_chunk(i)
+                except Exception as e:
+                    last_error = f"{src}: chunk {i}: {e}"
+                    log.debug("snapshot source %s failed at chunk %d: %s",
+                              src, i, e)
+                    telemetry.event("snapshot_source_failed", source=src,
+                                    stage=f"chunk/{i}", error=str(e))
+                    source_dead = True
+                    break
+                if layout.sha256_hex(data) == chunks[i]["sha256"]:
+                    journal.commit_chunk(i, data)
+                    ok = True
+                    break
+                trace.inc("snapshot.chunk_integrity_failures")
+                last_error = f"{src}: chunk {i}: hash mismatch"
+                telemetry.event("snapshot_chunk_corrupt", source=src,
+                                chunk=i, attempt=attempt)
+            if source_dead:
+                break
+            if not ok:
+                source_dead = True  # integrity retries exhausted here
+                break
+            progress["verified"] = progress.get("verified", 0) + 1
+            trace.inc("snapshot.chunks_fetched")
+        if source_dead:
+            continue  # next source; journaled chunks carry over
+        return await _finish(state, journal, progress, src, rpcs)
+    raise SnapshotError("sources_exhausted", last_error)
+
+
+async def _finish(state, journal, progress: dict, src: str,
+                  rpcs: int) -> dict:
+    manifest = journal.manifest
+    progress["phase"] = "verify"
+    payload = journal.assemble()
+    if layout.sha256_hex(payload) != manifest["payload_sha256"]:
+        # each chunk verified individually, so this means the manifest
+        # itself is inconsistent — poison, not a transport problem
+        journal.destroy()
+        raise SnapshotError("payload_hash_mismatch", src)
+    tables, txs, blocks = parse_payload(payload)
+    if not blocks or blocks[-1][1] != manifest["anchor_hash"] or \
+            blocks[-1][0] != manifest["anchor_height"]:
+        journal.destroy()
+        raise SnapshotError("anchor_mismatch", src)
+    # prove the payload against the manifest's fingerprints BEFORE any
+    # database write — the db never ingests unproven rows
+    if fingerprint_rows(tables["unspent_outputs"]) != \
+            manifest["utxo_fingerprint"] or \
+            full_fingerprint(tables) != manifest["full_state_fingerprint"]:
+        journal.destroy()
+        raise SnapshotError("fingerprint_mismatch", src)
+    progress["phase"] = "restore"
+    await state.restore_snapshot(tables, txs, blocks)
+    # and cross-check what the database now reports (catches a broken
+    # restore path, not a broken peer)
+    if await state.get_unspent_outputs_hash() != \
+            manifest["utxo_fingerprint"] or \
+            await state.get_full_state_hash() != \
+            manifest["full_state_fingerprint"]:
+        raise SnapshotError("restored_state_mismatch", src)
+    journal.destroy()
+    progress["phase"] = "done"
+    trace.inc("snapshot.restores")
+    telemetry.event("snapshot_restore_complete", source=src,
+                    height=manifest["anchor_height"],
+                    anchor=manifest["anchor_hash"],
+                    chunks=len(manifest["chunks"]),
+                    reused=progress.get("reused", 0), rpcs=rpcs)
+    return {
+        "method": "snapshot",
+        "height": manifest["anchor_height"],
+        "anchor": manifest["anchor_hash"],
+        "chunks": len(manifest["chunks"]),
+        "chunks_reused": progress.get("reused", 0),
+        "source": src,
+        "rpcs": rpcs,
+    }

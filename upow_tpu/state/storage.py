@@ -1355,3 +1355,97 @@ class ChainState(StateViews):
             await self.remove_outputs([tx])
         self._commit()
         self._index_rebuild()  # replay rewrote the tables wholesale
+
+    # ---------------------------------------------------------- snapshots --
+    # Canonical positional row shapes shared with the pg backend (the
+    # snapshot payload is backend-neutral, docs/SNAPSHOT.md):
+    #   unspent_outputs  [tx_hash, idx, address|null, amount, is_stake]
+    #   governance       [tx_hash, idx, address|null, amount]
+    #   tx               [block_hash, tx_hash, tx_hex, inputs_addresses,
+    #                     outputs_addresses, outputs_amounts, fees]
+    #   block            [id, hash, content, address, random,
+    #                     str(difficulty), reward, timestamp]
+    # Amounts/fees/rewards are int smallest-units everywhere; lists are
+    # real JSON arrays (this backend stores them json-encoded).
+
+    async def export_snapshot_rows(self, table: str) -> List[list]:
+        if table not in ("unspent_outputs",) + _GOV_TABLES:
+            raise ValueError(f"not a snapshot table: {table}")
+        if table == "unspent_outputs":
+            rows = self.db.execute(
+                "SELECT tx_hash, idx, address, amount, is_stake FROM"
+                " unspent_outputs ORDER BY tx_hash, idx").fetchall()
+            return [[r["tx_hash"], r["idx"], r["address"], r["amount"],
+                     r["is_stake"]] for r in rows]
+        rows = self.db.execute(
+            f"SELECT tx_hash, idx, address, amount FROM {table}"
+            " ORDER BY tx_hash, idx").fetchall()
+        return [[r["tx_hash"], r["idx"], r["address"], r["amount"]]
+                for r in rows]
+
+    async def export_snapshot_txs(self, tail: int) -> List[list]:
+        """Witness transactions: every tx still referenced by an
+        exported outpoint (the pg schema resolves amounts through — and
+        foreign-keys onto — the transactions table, so UTXO rows alone
+        cannot restore there) plus all txs of the carried block tail."""
+        union = " UNION ".join(
+            f"SELECT tx_hash FROM {t}"
+            for t in ("unspent_outputs",) + _GOV_TABLES)
+        rows = self.db.execute(
+            "SELECT block_hash, tx_hash, tx_hex, inputs_addresses,"
+            " outputs_addresses, outputs_amounts, fees FROM transactions"
+            f" WHERE tx_hash IN ({union}) OR block_hash IN"
+            " (SELECT hash FROM blocks ORDER BY id DESC LIMIT ?)"
+            " ORDER BY tx_hash", (tail,)).fetchall()
+        return [[r["block_hash"], r["tx_hash"], r["tx_hex"],
+                 json.loads(r["inputs_addresses"]),
+                 json.loads(r["outputs_addresses"]),
+                 json.loads(r["outputs_amounts"]), r["fees"]] for r in rows]
+
+    async def export_snapshot_blocks(self, tail: int) -> List[list]:
+        rows = self.db.execute(
+            "SELECT id, hash, content, address, random, difficulty,"
+            " reward, timestamp FROM blocks ORDER BY id DESC LIMIT ?",
+            (tail,)).fetchall()
+        return [[r["id"], r["hash"], r["content"], r["address"],
+                 r["random"], str(r["difficulty"]), r["reward"],
+                 r["timestamp"]] for r in reversed(rows)]
+
+    async def restore_snapshot(self, tables: Dict[str, List[list]],
+                               txs: List[list], blocks: List[list]) -> None:
+        """Wholesale replace of chain state with verified snapshot rows.
+        Callers verify every chunk hash AND the recomputed UTXO
+        fingerprint against the manifest BEFORE calling — one
+        transaction, so a crash mid-restore leaves the previous state
+        intact (atomic() rolls back)."""
+        for name in tables:
+            if name not in ("unspent_outputs",) + _GOV_TABLES:
+                raise ValueError(f"not a snapshot table: {name}")
+        async with self.atomic():
+            for table in ("unspent_outputs",) + _GOV_TABLES:
+                self.db.execute(f"DELETE FROM {table}")
+            for table in ("pending_spent_outputs", "pending_transactions",
+                          "transactions", "blocks"):
+                self.db.execute(f"DELETE FROM {table}")
+            self.db.executemany(
+                "INSERT INTO blocks (id, hash, content, address, random,"
+                " difficulty, reward, timestamp) VALUES (?,?,?,?,?,?,?,?)",
+                [tuple(r) for r in blocks])
+            self.db.executemany(
+                "INSERT INTO transactions (block_hash, tx_hash, tx_hex,"
+                " inputs_addresses, outputs_addresses, outputs_amounts,"
+                " fees) VALUES (?,?,?,?,?,?,?)",
+                [(r[0], r[1], r[2], json.dumps(r[3]), json.dumps(r[4]),
+                  json.dumps(r[5]), r[6]) for r in txs])
+            self.db.executemany(
+                "INSERT INTO unspent_outputs (tx_hash, idx, address,"
+                " amount, is_stake) VALUES (?,?,?,?,?)",
+                [tuple(r) for r in tables.get("unspent_outputs", [])])
+            for table in _GOV_TABLES:
+                self.db.executemany(
+                    f"INSERT INTO {table} (tx_hash, idx, address, amount)"
+                    " VALUES (?,?,?,?)",
+                    [tuple(r) for r in tables.get(table, [])])
+        self._amount_cache.clear()
+        self._bump_fees_gen()
+        self._index_rebuild()  # restore rewrote the tables wholesale

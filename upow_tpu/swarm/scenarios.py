@@ -31,6 +31,9 @@ import asyncio
 import contextlib
 import hashlib
 import json
+import os
+import shutil
+import tempfile
 import time
 from dataclasses import dataclass, field
 from decimal import Decimal
@@ -568,6 +571,139 @@ async def scenario_ws_churn(swarm: Swarm, seed: int):
     return core, observed
 
 
+def _snapshot_churn_cfg(i: int, cfg) -> None:
+    """One-block sync pages: full replay pays one RPC per block, so the
+    snapshot-vs-replay RPC comparison bites at swarm chain lengths."""
+    cfg.node.sync_page = 1
+
+
+def _joiner_rpcs(swarm: Swarm, i: int) -> int:
+    """Outbound RPC attempts node ``i`` has made (delivered + shed) —
+    the per-ordered-link matrix counters, driver traffic excluded."""
+    prefix = swarm.urls[i] + "->"
+    return sum(row["delivered"] + row["dropped"] + row["blocked"]
+               for link, row in swarm.matrix.per_link.items()
+               if link.startswith(prefix))
+
+
+async def scenario_snapshot_churn(swarm: Swarm, seed: int):
+    """Crash-safe onboarding (docs/SNAPSHOT.md): a blank node restores
+    from a snapshot while its serving peer is corrupted mid-chunk and
+    then partitioned mid-transfer — it must fail over to the second
+    source, resume from journaled chunks, and land on the byte-exact
+    UTXO fingerprint; a second blank node measures the full-replay RPC
+    baseline; a third faces permanently-poisoned chunks and must fall
+    back to full replay with a structured reason instead of failing
+    the join."""
+    assert swarm.n >= 5, "snapshot_churn needs 5 nodes"
+    urls = swarm.urls
+    _, addr = _wallet(seed, "shared")
+    tmp = tempfile.mkdtemp(prefix="snapshot-churn-")
+    try:
+        # nodes 0/1: servers; 2: snapshot joiner; 3: replay baseline;
+        # 4: forced-integrity-failure joiner (isolated topology: only
+        # the peers a phase names below exist for each node)
+        for i in (0, 1, 2, 4):
+            scfg = swarm.nodes[i].config.snapshot
+            scfg.dir = os.path.join(tmp, f"n{i}")
+            scfg.chunk_bytes = 2048  # multi-chunk transfers at swarm scale
+            scfg.blocks_tail = 8
+        swarm.nodes[4].peers.add(urls[1])  # replay-fallback source
+
+        for _ in range(24):
+            assert (await swarm.mine(0, addr, push_to=[0, 1]))["ok"]
+        m0 = await swarm.nodes[0].build_snapshot()
+        m1 = await swarm.nodes[1].build_snapshot()
+        assert m0 is not None and m1 is not None
+
+        # phase A — snapshot onboarding under fire: node 0 serves chunk
+        # 1 corrupted twice (integrity retries must absorb it) and every
+        # node-0 fetch is slowed so the transfer is still mid-flight
+        # when the partition cuts node 0 away
+        faultinject.install(
+            "snapshot.serve:corrupt:times=2,key=chunk/1;"
+            "snapshot.fetch:latency:delay=0.02,key=10.77.0.1", seed)
+        base2 = _joiner_rpcs(swarm, 2)
+        with swarm.nodes[2].telemetry_scope.activate():
+            boot2 = asyncio.ensure_future(
+                swarm.nodes[2].bootstrap_from_snapshot(
+                    sources=[urls[0], urls[1]]))
+        progress = swarm.nodes[2].snapshot_restore
+        for _ in range(2000):
+            if progress.get("verified", 0) >= 3:
+                break
+            await asyncio.sleep(0.002)
+        partitioned_mid_transfer = \
+            0 < progress.get("verified", 0) < progress.get("total", 0)
+        swarm.matrix.partition([[urls[0]], urls[1:]])
+        res2 = await boot2
+        rpcs2 = _joiner_rpcs(swarm, 2) - base2
+        faultinject.uninstall()
+
+        # phase B — the same onboarding, the old way: full block replay
+        base3 = _joiner_rpcs(swarm, 3)
+        res3 = await _sync_from(swarm, 3, winner=1)
+        rpcs3 = _joiner_rpcs(swarm, 3) - base3
+
+        # phase C — every chunk from every source poisoned: the join
+        # must degrade to replay with a structured reason, not fail
+        faultinject.install("snapshot.serve:corrupt", seed + 1)
+        with swarm.nodes[4].telemetry_scope.activate():
+            res4 = await swarm.nodes[4].bootstrap_from_snapshot(
+                sources=[urls[1]])
+        faultinject.uninstall()
+
+        fp0 = await swarm.nodes[0].state.get_unspent_outputs_hash()
+        full0 = await swarm.nodes[0].state.get_full_state_hash()
+        fp2 = await swarm.nodes[2].state.get_unspent_outputs_hash()
+        full2 = await swarm.nodes[2].state.get_full_state_hash()
+        tips = await swarm.tips()
+        corrupt_events = fleet_scrape.merged_events(
+            swarm, kind="snapshot_chunk_corrupt")
+        fallback_events = fleet_scrape.merged_events(
+            swarm, kind="snapshot_fallback")
+        recommended = fleet_scrape.merged_events(
+            swarm, kind="snapshot_recommended")
+        core = {
+            "servers_published_identical":
+                m0["payload_sha256"] == m1["payload_sha256"],
+            "snapshot_joiner_ok": bool(res2.get("ok"))
+                and res2.get("method") == "snapshot",
+            "partitioned_mid_transfer": partitioned_mid_transfer,
+            "failed_over_to_second_source":
+                res2.get("source") == urls[1],
+            "resumed_journaled_chunks": res2.get("chunks_reused", 0) > 0,
+            "corruption_caught_by_integrity": len(corrupt_events) >= 1,
+            "joiner_fingerprint_exact": fp2 == fp0 and full2 == full0,
+            "snapshot_fewer_rpcs_than_replay": rpcs2 < rpcs3,
+            "replay_joiner_ok": bool(res3.get("ok")),
+            "poisoned_join_fell_back": res4.get("method")
+                == "replay_fallback" and bool(res4.get("ok")),
+            "fallback_reason_structured": res4.get("reason")
+                == "sources_exhausted" and len(fallback_events) >= 1,
+            "snapshot_recommended_emitted": len(recommended) >= 1,
+            "all_converged": len({t["hash"] for t in tips}) == 1,
+            "final_height": tips[0]["id"],
+            "final_tip": tips[0]["hash"],
+            "utxo_fingerprint": fp0,
+        }
+        observed = {
+            "snapshot_rpcs": rpcs2,
+            "replay_rpcs": rpcs3,
+            "snapshot_result": res2,
+            "replay_result": {k: res3.get(k) for k in ("ok", "error")},
+            "fallback_result": {k: res4.get(k)
+                                for k in ("ok", "method", "reason")},
+            "manifest_chunks": len(m0["chunks"]),
+            "corrupt_events": len(corrupt_events),
+            "restore_progress": dict(swarm.nodes[2].snapshot_restore),
+        }
+        return core, observed
+    finally:
+        faultinject.uninstall()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # ------------------------------------------------------------- registry ----
 
 @dataclass(frozen=True)
@@ -598,6 +734,11 @@ SCENARIOS: Dict[str, ScenarioSpec] = {
     "ws_churn": ScenarioSpec(
         scenario_ws_churn, nodes=2, fast=True,
         swarm_kwargs={"ws": True, "ws_queue_max": 4}),
+    "snapshot_churn": ScenarioSpec(
+        scenario_snapshot_churn, nodes=5, fast=True,
+        topology="isolated",
+        swarm_kwargs={"reorg_window": 4,
+                      "cfg_hook": _snapshot_churn_cfg}),
 }
 
 # The geo soak lives in the fleet package (fleet/geosoak.py: continent

@@ -192,14 +192,16 @@ class LoopbackInterface(NodeInterface):
         return await self._resilient(attempt, path)
 
     async def get(self, path: str, params: Optional[dict] = None,
-                  sender_node: str = "") -> dict:
+                  sender_node: str = "", site: Optional[str] = None,
+                  site_key: Optional[str] = None) -> dict:
         headers = self._rpc_headers(sender_node)
 
         async def attempt() -> dict:
             return await self._call("GET", path, params=params or {},
                                     headers=headers)
 
-        return await self._resilient(attempt, path)
+        return await self._resilient(attempt, path, site=site,
+                                     site_key=site_key)
 
 
 class LoopbackWsClient:
