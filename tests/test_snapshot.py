@@ -346,6 +346,179 @@ def test_malformed_manifest_skips_to_next_source(tmp_path):
     run(main())
 
 
+class RawSource:
+    """Fake peer serving attacker-crafted payload bytes under a
+    manifest whose hashes are all internally consistent — only the row
+    contents are hostile."""
+
+    def __init__(self, payload, anchor_height=1, anchor_hash="b" * 64):
+        self.base_url = "http://raw.local"
+        self._chunks = [payload[i:i + 512]
+                        for i in range(0, len(payload), 512)] or [b""]
+        self.manifest = {
+            "version": layout.MANIFEST_VERSION,
+            "anchor_height": anchor_height,
+            "anchor_hash": anchor_hash,
+            "utxo_fingerprint": "c" * 64,
+            "full_state_fingerprint": "d" * 64,
+            "chunk_bytes": 512,
+            "payload_bytes": len(payload),
+            "payload_sha256": layout.sha256_hex(payload),
+            "chunks": [{"i": i, "sha256": layout.sha256_hex(c),
+                        "size": len(c)}
+                       for i, c in enumerate(self._chunks)],
+            "counts": {},
+        }
+
+    async def snapshot_manifest(self):
+        return self.manifest
+
+    async def snapshot_chunk(self, i):
+        return self._chunks[i]
+
+
+def test_hostile_manifests_fail_over_without_touching_disk(tmp_path):
+    """REVIEW regressions: a traversal payload_sha256, an oversize
+    chunk list, and a manifest missing payload_sha256 are all rejected
+    at validation (no journal dir, no KeyError) and the client fails
+    over to the honest source."""
+    async def main():
+        state = await _populated_state(blocks=3)
+        root = str(tmp_path / "server")
+        await builder.build_snapshot(state, root, chunk_bytes=512)
+        good = DiskSource(root)
+        evil = DiskSource(root)
+        evil.manifest = dict(good.manifest,
+                             payload_sha256="../../../../etc/x")
+        huge = DiskSource(root)
+        huge.manifest = dict(
+            good.manifest,
+            chunks=[{"i": i, "sha256": "a" * 64, "size": 1024}
+                    for i in range(100_000)],
+            payload_bytes=1024 * 100_000)
+        nokey = DiskSource(root)
+        nokey.manifest = {k: v for k, v in good.manifest.items()
+                          if k != "payload_sha256"}
+        joiner = ChainState()
+        jroot = str(tmp_path / "joiner")
+        res = await client.bootstrap_from_snapshot(
+            joiner, [evil, huge, nokey, good], jroot)
+        assert res["source"] == good.base_url
+        # none of the hostile manifests ever became a journal dir —
+        # only the honest identity was created (and then destroyed)
+        assert os.listdir(os.path.join(jroot, "restore")) == []
+        assert await joiner.get_full_state_hash() == \
+            await state.get_full_state_hash()
+        state.close()
+        joiner.close()
+
+    run(main())
+
+
+def test_malformed_payload_rows_stay_inside_the_error_ladder(tmp_path):
+    """REVIEW regression: non-list rows, short block rows and dict tx
+    rows must surface as SnapshotError (the only exception the replay
+    fallback catches), never TypeError/IndexError."""
+    async def main():
+        joiner = ChainState()
+        for line in (b'{"t":"unspent_outputs","r":5}\n',
+                     b'{"t":"block","r":[1,"x"]}\n',
+                     b'{"t":"tx","r":{"a":1}}\n'):
+            with pytest.raises(SnapshotError) as e:
+                await client.bootstrap_from_snapshot(
+                    joiner, [RawSource(line)], str(tmp_path / "j"))
+            assert e.value.reason == "payload_malformed"
+            assert await joiner.get_last_block() is None
+        joiner.close()
+
+    run(main())
+
+
+def test_chunk_size_lie_is_an_integrity_failure(tmp_path):
+    """A manifest whose declared chunk sizes disagree with the bytes
+    that actually hash correctly is abandoned like any other integrity
+    failure — the size field bounds journal and assembly work, so a
+    hash match alone must not admit the chunk."""
+    async def main():
+        state = await _populated_state(blocks=3)
+        root = str(tmp_path / "server")
+        await builder.build_snapshot(state, root, chunk_bytes=512)
+        liar = DiskSource(root)
+        chunks = [dict(c) for c in liar.manifest["chunks"]]
+        delta = chunks[0]["size"] - 1
+        chunks[0]["size"] = 1
+        liar.manifest = dict(
+            liar.manifest, chunks=chunks,
+            payload_bytes=liar.manifest["payload_bytes"] - delta)
+        joiner = ChainState()
+        with pytest.raises(SnapshotError) as e:
+            await client.bootstrap_from_snapshot(
+                joiner, [liar], str(tmp_path / "joiner"))
+        assert e.value.reason == "sources_exhausted"
+        assert "chunk 0" in e.value.detail
+        assert await joiner.get_last_block() is None
+        state.close()
+        joiner.close()
+
+    run(main())
+
+
+def test_superseded_journal_dirs_are_pruned(tmp_path):
+    """REVIEW regression: failing over to a new payload identity must
+    not leak the old identity's journal dir forever."""
+    async def main():
+        state = await _populated_state()
+        root = str(tmp_path / "server")
+        await builder.build_snapshot(state, root, chunk_bytes=512)
+        joiner = ChainState()
+        jroot = str(tmp_path / "joiner")
+        with pytest.raises(SnapshotError):
+            await client.bootstrap_from_snapshot(
+                joiner, [DiskSource(root, fail_after=1)], jroot)
+        assert len(os.listdir(os.path.join(jroot, "restore"))) == 1
+        # the chain advances -> a rebuild publishes a NEW payload
+        # identity; bootstrapping against it supersedes the old journal
+        manager = BlockManager(state, sig_backend="host")
+        _, addr = make_actors()["genesis"]
+        await mine_block(manager, state, addr)
+        await builder.build_snapshot(state, root, chunk_bytes=512)
+        res = await client.bootstrap_from_snapshot(
+            joiner, [DiskSource(root)], jroot)
+        assert res["method"] == "snapshot"
+        assert os.listdir(os.path.join(jroot, "restore")) == []
+        state.close()
+        joiner.close()
+
+    run(main())
+
+
+def test_restored_state_mismatch_resets_to_blank_state(tmp_path):
+    """REVIEW regression: when the post-commit db cross-check fails,
+    the unproven restore is wiped (replay falls back to genesis, not on
+    top of it) and the journal does not outlive the attempt."""
+    async def main():
+        state = await _populated_state()
+        root = str(tmp_path / "server")
+        await builder.build_snapshot(state, root, chunk_bytes=512)
+        joiner = ChainState()
+
+        async def lying_hash():
+            return "0" * 64
+
+        joiner.get_unspent_outputs_hash = lying_hash
+        jroot = str(tmp_path / "joiner")
+        with pytest.raises(SnapshotError) as e:
+            await client.bootstrap_from_snapshot(
+                joiner, [DiskSource(root)], jroot)
+        assert e.value.reason == "restored_state_mismatch"
+        assert await joiner.get_last_block() is None
+        assert os.listdir(os.path.join(jroot, "restore")) == []
+        state.close()
+        joiner.close()
+
+    run(main())
+
+
 # ------------------------------------------------- snapshot_recommended ----
 
 def test_sync_far_behind_emits_snapshot_recommended():

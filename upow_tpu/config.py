@@ -263,6 +263,11 @@ class SnapshotConfig:
                                     # pruned (never raising)
     chunk_retries: int = 2          # per-chunk integrity retries against
                                     # ONE source before failing over
+    max_chunks: int = 1 << 14       # restore-side ceilings on what a
+    max_chunk_bytes: int = 16 << 20  # peer manifest may declare; an
+    max_payload_bytes: int = 1 << 30  # oversize manifest is rejected
+                                    # before any chunk is fetched
+                                    # (anti-DoS on the bootstrap path)
 
 
 @dataclass

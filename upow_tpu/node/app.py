@@ -1087,13 +1087,22 @@ class Node:
                 result = await bootstrap_from_snapshot(
                     self.state, ifaces, scfg.dir,
                     chunk_retries=scfg.chunk_retries,
-                    progress=self.snapshot_restore)
+                    progress=self.snapshot_restore,
+                    max_chunks=scfg.max_chunks,
+                    max_chunk_bytes=scfg.max_chunk_bytes,
+                    max_payload_bytes=scfg.max_payload_bytes)
                 # restored state invalidates everything derived from it
                 self.hotcache.bump("snapshot_restore")
                 self.manager.invalidate_difficulty()
                 return {"ok": True, **result}
             except SnapshotError as e:
                 reason, detail = e.reason, e.detail
+                if reason == "restored_state_mismatch":
+                    # the client wiped the committed-but-unproven
+                    # restore back to a blank chain — derived caches
+                    # must not outlive it
+                    self.hotcache.bump("snapshot_restore")
+                    self.manager.invalidate_difficulty()
             finally:
                 for iface in ifaces:
                     await iface.close()

@@ -1,7 +1,13 @@
 """Resumable snapshot bootstrap (the restore half of docs/SNAPSHOT.md).
 
-Trust model: the serving peer is NOT trusted.  Every chunk is verified
-against the manifest's sha256 before it is journaled; the assembled
+Trust model: the serving peer is NOT trusted.  The manifest itself is
+validated first — strict 64-hex hashes (``payload_sha256`` names the
+journal directory, so this is also the path-traversal gate), exact row
+``i``/``size`` fields, and resource ceilings (``MAX_CHUNKS`` /
+``MAX_CHUNK_BYTES`` / ``MAX_PAYLOAD_BYTES``) rejecting a manifest that
+would have the client journal or assemble an unbounded payload.  Every
+chunk is verified against the manifest's sha256 AND declared size
+before it is journaled; the assembled
 payload is verified against ``payload_sha256``; and the UTXO + full
 state fingerprints are recomputed CLIENT-SIDE from the parsed rows and
 compared to the manifest's anchors before a single database write —
@@ -31,6 +37,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import re
 import shutil
 from typing import Dict, List, Optional
 
@@ -52,17 +59,70 @@ class SnapshotError(Exception):
         self.detail = detail
 
 
-def _manifest_ok(m: dict) -> bool:
+# Resource ceilings on what a manifest may declare.  Without them a
+# malicious peer could make a joining node download, journal and then
+# assemble a multi-GB payload in memory — a disk/memory exhaustion DoS
+# on the bootstrap path.  Overridable per call (SnapshotConfig wires
+# them through Node.bootstrap_from_snapshot).
+MAX_CHUNKS = 1 << 14            # 16384 manifest entries
+MAX_CHUNK_BYTES = 16 << 20      # 16 MiB per chunk
+MAX_PAYLOAD_BYTES = 1 << 30     # 1 GiB assembled payload
+
+_HEX64 = re.compile(r"[0-9a-f]{64}")
+
+
+def _manifest_error(m: dict, max_chunks: int = MAX_CHUNKS,
+                    max_chunk_bytes: int = MAX_CHUNK_BYTES,
+                    max_payload_bytes: int = MAX_PAYLOAD_BYTES
+                    ) -> Optional[str]:
+    """None when the manifest is well-formed and within the resource
+    ceilings, else ``"malformed"`` / ``"oversize"``.  ``payload_sha256``
+    names the journal directory, so the strict 64-hex check here is
+    also the path-traversal gate — an attacker-chosen string must never
+    become a path component."""
     try:
-        return (m["version"] == layout.MANIFEST_VERSION
+        if not (m["version"] == layout.MANIFEST_VERSION
                 and isinstance(m["anchor_hash"], str)
-                and int(m["anchor_height"]) > 0
+                and isinstance(m["anchor_height"], int)
+                and m["anchor_height"] > 0
+                and isinstance(m["payload_sha256"], str)
+                and _HEX64.fullmatch(m["payload_sha256"])
+                and isinstance(m["payload_bytes"], int)
+                and isinstance(m["utxo_fingerprint"], str)
+                and isinstance(m["full_state_fingerprint"], str)
                 and isinstance(m["chunks"], list) and m["chunks"]
                 and all(isinstance(c["sha256"], str)
+                        and _HEX64.fullmatch(c["sha256"])
                         and int(c["i"]) == i
-                        for i, c in enumerate(m["chunks"])))
+                        and isinstance(c["size"], int) and c["size"] >= 0
+                        for i, c in enumerate(m["chunks"]))):
+            return "malformed"
+        if m["payload_bytes"] != sum(c["size"] for c in m["chunks"]):
+            return "malformed"
     except (KeyError, TypeError, ValueError):
+        return "malformed"
+    if (len(m["chunks"]) > max_chunks
+            or m["payload_bytes"] > max_payload_bytes
+            or any(c["size"] > max_chunk_bytes for c in m["chunks"])):
+        return "oversize"
+    return None
+
+
+_ROW_ARITY = {"tx": 7, "block": 8, "unspent_outputs": 5}
+
+
+def _row_ok(t: str, r) -> bool:
+    """Shape check for one payload row: the exact arity the restore SQL
+    binds, plus scalar types on the fields the client itself indexes
+    (sort keys, anchor comparison) — so untrusted rows can never raise
+    TypeError/IndexError past the SnapshotError ladder."""
+    if not isinstance(r, list) or len(r) != _ROW_ARITY.get(t, 4):
         return False
+    if t == "tx":
+        return isinstance(r[1], str)
+    if t == "block":
+        return isinstance(r[0], int) and isinstance(r[1], str)
+    return isinstance(r[0], str) and isinstance(r[1], int)
 
 
 def parse_payload(payload: bytes) -> tuple:
@@ -78,14 +138,18 @@ def parse_payload(payload: bytes) -> tuple:
         except (ValueError, KeyError, TypeError):
             raise SnapshotError("payload_malformed", f"line {ln}")
         if t in tables:
-            tables[t].append(r)
+            dest = tables[t]
         elif t == "tx":
-            txs.append(r)
+            dest = txs
         elif t == "block":
-            blocks.append(r)
+            dest = blocks
         else:
             raise SnapshotError("payload_malformed",
                                 f"line {ln}: unknown section {t!r}")
+        if not _row_ok(t, r):
+            raise SnapshotError("payload_malformed",
+                                f"line {ln}: bad {t} row shape")
+        dest.append(r)
     return tables, txs, blocks
 
 
@@ -112,8 +176,23 @@ class _Journal:
 
     def __init__(self, root: str, manifest: dict):
         self.manifest = manifest
-        self.dir = os.path.join(root, "restore",
-                                manifest["payload_sha256"][:16])
+        ident = manifest["payload_sha256"][:16]
+        base = os.path.realpath(os.path.join(root, "restore"))
+        self.dir = os.path.realpath(os.path.join(base, ident))
+        # _manifest_error's 64-hex check is the real gate; this is the
+        # belt-and-braces containment assert behind it
+        if os.path.dirname(self.dir) != base:
+            raise SnapshotError("journal_path_escape", ident)
+        # prune journals of superseded payload identities (each failed
+        # bootstrap against a different anchor would otherwise leak one
+        # dir forever); only the identity being restored survives
+        try:
+            for name in os.listdir(base):
+                if name != ident:
+                    shutil.rmtree(os.path.join(base, name),
+                                  ignore_errors=True)
+        except OSError:
+            pass
         os.makedirs(self.dir, exist_ok=True)
         layout.write_manifest(os.path.join(self.dir, layout.MANIFEST_NAME),
                               manifest)
@@ -154,7 +233,11 @@ class _Journal:
 
 async def bootstrap_from_snapshot(state, sources, root: str,
                                   chunk_retries: int = 2,
-                                  progress: Optional[dict] = None) -> dict:
+                                  progress: Optional[dict] = None,
+                                  max_chunks: int = MAX_CHUNKS,
+                                  max_chunk_bytes: int = MAX_CHUNK_BYTES,
+                                  max_payload_bytes: int = MAX_PAYLOAD_BYTES
+                                  ) -> dict:
     """Restore ``state`` from the first healthy source in ``sources``
     (NodeInterface instances, already health-ranked by the caller).
 
@@ -182,10 +265,14 @@ async def bootstrap_from_snapshot(state, sources, root: str,
             telemetry.event("snapshot_source_failed", source=src,
                             stage="manifest", error=str(e))
             continue
-        if not isinstance(manifest, dict) or not _manifest_ok(manifest):
-            last_error = f"{src}: manifest malformed"
+        err = "malformed" if not isinstance(manifest, dict) else \
+            _manifest_error(manifest, max_chunks=max_chunks,
+                            max_chunk_bytes=max_chunk_bytes,
+                            max_payload_bytes=max_payload_bytes)
+        if err is not None:
+            last_error = f"{src}: manifest {err}"
             telemetry.event("snapshot_source_failed", source=src,
-                            stage="manifest", error="malformed")
+                            stage="manifest", error=err)
             continue
         if journal is None or \
                 journal.manifest["payload_sha256"] != \
@@ -222,7 +309,11 @@ async def bootstrap_from_snapshot(state, sources, root: str,
                                     stage=f"chunk/{i}", error=str(e))
                     source_dead = True
                     break
-                if layout.sha256_hex(data) == chunks[i]["sha256"]:
+                # the size check keeps the journal/assembly bounded by
+                # what the (ceiling-checked) manifest declared — a hash
+                # match alone would let the peer lie about sizes
+                if len(data) == chunks[i]["size"] and \
+                        layout.sha256_hex(data) == chunks[i]["sha256"]:
                     journal.commit_chunk(i, data)
                     ok = True
                     break
@@ -247,32 +338,58 @@ async def _finish(state, journal, progress: dict, src: str,
                   rpcs: int) -> dict:
     manifest = journal.manifest
     progress["phase"] = "verify"
-    payload = journal.assemble()
-    if layout.sha256_hex(payload) != manifest["payload_sha256"]:
-        # each chunk verified individually, so this means the manifest
-        # itself is inconsistent — poison, not a transport problem
+    try:
+        payload = journal.assemble()
+        if layout.sha256_hex(payload) != manifest["payload_sha256"]:
+            # each chunk verified individually, so this means the
+            # manifest itself is inconsistent — poison, not transport
+            raise SnapshotError("payload_hash_mismatch", src)
+        tables, txs, blocks = parse_payload(payload)
+        if not blocks or blocks[-1][1] != manifest["anchor_hash"] or \
+                blocks[-1][0] != manifest["anchor_height"]:
+            raise SnapshotError("anchor_mismatch", src)
+        # prove the payload against the manifest's fingerprints BEFORE
+        # any database write — the db never ingests unproven rows
+        if fingerprint_rows(tables["unspent_outputs"]) != \
+                manifest["utxo_fingerprint"] or \
+                full_fingerprint(tables) != \
+                manifest["full_state_fingerprint"]:
+            raise SnapshotError("fingerprint_mismatch", src)
+    except SnapshotError:
         journal.destroy()
-        raise SnapshotError("payload_hash_mismatch", src)
-    tables, txs, blocks = parse_payload(payload)
-    if not blocks or blocks[-1][1] != manifest["anchor_hash"] or \
-            blocks[-1][0] != manifest["anchor_height"]:
+        raise
+    except Exception as e:
+        # untrusted bytes must never raise past the SnapshotError
+        # ladder — the caller's replay fallback catches only that
         journal.destroy()
-        raise SnapshotError("anchor_mismatch", src)
-    # prove the payload against the manifest's fingerprints BEFORE any
-    # database write — the db never ingests unproven rows
-    if fingerprint_rows(tables["unspent_outputs"]) != \
-            manifest["utxo_fingerprint"] or \
-            full_fingerprint(tables) != manifest["full_state_fingerprint"]:
-        journal.destroy()
-        raise SnapshotError("fingerprint_mismatch", src)
+        raise SnapshotError("peer_malformed",
+                            f"{src}: {type(e).__name__}: {e}")
     progress["phase"] = "restore"
-    await state.restore_snapshot(tables, txs, blocks)
-    # and cross-check what the database now reports (catches a broken
-    # restore path, not a broken peer)
-    if await state.get_unspent_outputs_hash() != \
-            manifest["utxo_fingerprint"] or \
-            await state.get_full_state_hash() != \
-            manifest["full_state_fingerprint"]:
+    try:
+        await state.restore_snapshot(tables, txs, blocks)
+        # and cross-check what the database now reports (catches a
+        # broken restore path, not a broken peer)
+        mismatch = (await state.get_unspent_outputs_hash() !=
+                    manifest["utxo_fingerprint"]
+                    or await state.get_full_state_hash() !=
+                    manifest["full_state_fingerprint"])
+    except Exception as e:
+        # atomic() rolled back: the pre-restore state is intact and the
+        # replay fallback can proceed on it
+        journal.destroy()
+        raise SnapshotError("restore_failed",
+                            f"{src}: {type(e).__name__}: {e}")
+    if mismatch:
+        # the unproven rows are already committed — wipe back to a
+        # blank chain so the replay fallback syncs from genesis rather
+        # than on top of state that failed its own cross-check
+        journal.destroy()
+        try:
+            await state.restore_snapshot(
+                {t: [] for t in SNAPSHOT_TABLES}, [], [])
+        except Exception:
+            log.exception("could not reset state after restored-state"
+                          " mismatch; replay fallback starts dirty")
         raise SnapshotError("restored_state_mismatch", src)
     journal.destroy()
     progress["phase"] = "done"
