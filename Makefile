@@ -1,7 +1,7 @@
 PYTHON ?= python
 
-.PHONY: lint test ruff metrics-check perf-observatory perf-smoke swarm \
-	fleet device-runtime-smoke snapshot-smoke
+.PHONY: lint lint-concurrency test ruff metrics-check perf-observatory \
+	perf-smoke swarm fleet device-runtime-smoke snapshot-smoke
 
 # Domain linter: consensus-endianness, consensus-purity, jit-purity,
 # dtype-hygiene, async-safety, broad-except, device-runtime purity.
@@ -9,6 +9,11 @@ PYTHON ?= python
 lint:
 	$(PYTHON) -m upow_tpu.lint upow_tpu/
 	@$(MAKE) --no-print-directory ruff
+
+# Interprocedural concurrency sweep only (docs/STATIC_ANALYSIS.md, RC
+# family): project-wide call graph + loop/thread coloring; RC001-RC005.
+lint-concurrency:
+	$(PYTHON) -m upow_tpu.lint --select RC upow_tpu/
 
 # Generic baseline (ruff.toml); skipped quietly where ruff is not
 # installed — the container bakes no ruff and we don't pip install.

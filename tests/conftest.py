@@ -49,6 +49,71 @@ def pytest_configure(config):
         "excluded from the tier-1 run via -m 'not slow'")
 
 
+# ---------------------------------------------------------------------------
+# Runtime concurrency sanitizer (upow_tpu.lint.sanitizer)
+#
+# Installed once per session: wraps asyncio's callback dispatch to time
+# every event-loop step (blocked-loop watchdog), patches the loop
+# exception handler to catch un-retrieved task exceptions, and arms the
+# thread-affinity hook at the device-runtime submit/drain seam.  Each
+# test drains findings at teardown and FAILS on product-attributed ones
+# — test code blocking its own loop (jax compiles, sync fixtures) is
+# reported by the sanitizer but does not gate.
+#
+#   UPOW_SANITIZER=0                  disable entirely
+#   UPOW_SANITIZER_THRESHOLD=<secs>   blocked-loop threshold (default 2.0
+#                                     under the full tier-1 suite, where
+#                                     cold jax compiles legitimately run
+#                                     long inside loop callbacks; chaos
+#                                     CI pins a strict 0.5)
+# ---------------------------------------------------------------------------
+
+_SANITIZER_ON = os.environ.get("UPOW_SANITIZER", "1") != "0"
+
+
+@pytest.fixture(scope="session")
+def _sanitizer_session():
+    if not _SANITIZER_ON:
+        yield None
+        return
+    from upow_tpu.lint import sanitizer
+
+    threshold = float(os.environ.get("UPOW_SANITIZER_THRESHOLD", "2.0"))
+    san = sanitizer.install(blocked_loop_threshold=threshold)
+    try:
+        yield san
+    finally:
+        sanitizer.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _sanitizer_gate(_sanitizer_session, recwarn, request):
+    """Drain sanitizer findings after every test; fail the test on
+    product-attributed ones.  ``recwarn`` keeps refcount-dropped
+    'coroutine ... was never awaited' warnings visible to the gate
+    (they fire mid-test, before the GC flush at teardown)."""
+    san = _sanitizer_session
+    if san is None:
+        yield
+        return
+    san.drain()                       # start clean (cross-test bleed)
+    yield
+    san.flush_never_awaited()
+    for w in recwarn.list:
+        san.record_never_awaited(str(w.message))
+    findings = san.drain()
+    gating = [f for f in findings if f.product]
+    benign = [f for f in findings if not f.product]
+    for f in benign:
+        sys.stderr.write(f"[sanitizer] note ({request.node.nodeid}): "
+                         f"{f.detail}\n")
+    if gating:
+        lines = "\n\n".join(str(f) for f in gating)
+        pytest.fail(
+            f"concurrency sanitizer: {len(gating)} product finding(s)\n"
+            f"{lines}", pytrace=False)
+
+
 @pytest.fixture(autouse=True)
 def _fresh_sig_verdicts():
     """The process-level signature-verdict cache must not leak verdicts

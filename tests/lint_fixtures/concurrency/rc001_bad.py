@@ -1,0 +1,30 @@
+"""RC001 bad: blocking calls on event-loop paths, direct and transitive.
+
+The transitive case is the point — a per-file walker sees nothing wrong
+with ``read_config`` (a plain sync function doing file I/O) and nothing
+wrong with ``handler`` (an async def making an innocent-looking call).
+Only the call graph connects them.
+"""
+import time
+
+
+def read_config(path):
+    with open(path) as f:  # RC001 reported HERE, chain in message
+        return f.read()
+
+
+def warm_cache(path):
+    return read_config(path)
+
+
+async def handler(path):
+    return warm_cache(path)
+
+
+async def poll():
+    time.sleep(0.5)  # RC001 depth-0: direct blocking in a coroutine
+
+
+async def justified():
+    # one-time startup read, loop not serving yet
+    time.sleep(0.0)  # upowlint: disable=RC001

@@ -1,0 +1,7 @@
+"""Async half of the cross-module RC001 pair: the blocking chain
+crosses the module boundary (reconnect -> resync -> backoff -> sleep)."""
+from .rc001_cross_helper import resync
+
+
+async def reconnect():
+    resync()

@@ -1,0 +1,15 @@
+"""Helper half of the cross-module RC001 pair.
+
+No async code lives here, so linting this file ALONE reports nothing —
+the blocking chain is only visible once the importing module joins the
+project context.
+"""
+import time
+
+
+def backoff():
+    time.sleep(1.0)  # RC001 reported here via the cross-module chain
+
+
+def resync():
+    backoff()

@@ -1,0 +1,28 @@
+"""RC001 good: blocking work crossed to executors/threads is clean."""
+import asyncio
+import threading
+import time
+
+
+def fetch(path):
+    with open(path) as f:  # no finding: only thread/executor callers
+        return f.read()
+
+
+async def handler(path):
+    return await asyncio.to_thread(fetch, path)
+
+
+async def handler2(path):
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, fetch, path)
+
+
+def worker():
+    time.sleep(0.5)  # no finding: thread-side blocking is legal
+
+
+def spawn():
+    t = threading.Thread(target=worker)
+    t.start()
+    return t

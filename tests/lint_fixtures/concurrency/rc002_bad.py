@@ -1,0 +1,14 @@
+"""RC002 bad: one attribute, two execution worlds, no lock."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self.total = 0  # no finding: __init__ writes are construction
+        self._t = threading.Thread(target=self._drain)
+
+    def _drain(self):
+        self.total += 1  # RC002: thread-side write, unguarded
+
+    async def report(self):
+        self.total = 0  # loop-side write of the same attribute

@@ -1,0 +1,17 @@
+"""RC002 good: the same two-world write pattern, lock-guarded."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self.total = 0
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._drain)
+
+    def _drain(self):
+        with self._lock:
+            self.total += 1  # no finding: guarded on both sides
+
+    async def report(self):
+        with self._lock:
+            self.total = 0  # no finding

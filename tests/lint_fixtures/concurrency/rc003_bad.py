@@ -1,0 +1,12 @@
+"""RC003 bad: a threading lock held across an await."""
+import asyncio
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    async def flush(self):
+        with self._mu:
+            await asyncio.sleep(0)  # RC003: loop latency leaks into _mu

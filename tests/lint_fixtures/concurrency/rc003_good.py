@@ -1,0 +1,18 @@
+"""RC003 good: release before awaiting, or use the loop-native lock."""
+import asyncio
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._amu = asyncio.Lock()
+
+    async def flush(self):
+        with self._mu:
+            snapshot = 1  # no finding: released before the await
+        await asyncio.sleep(snapshot)
+
+    async def flush_async(self):
+        async with self._amu:
+            await asyncio.sleep(0)  # no finding: asyncio.Lock is loop-native

@@ -1,0 +1,14 @@
+"""RC004 bad: fire-and-forget leaks."""
+import asyncio
+
+
+async def job():
+    await asyncio.sleep(0)
+
+
+async def kick():
+    asyncio.create_task(job())  # RC004: handle dropped on the floor
+
+
+async def typo():
+    job()  # RC004: coroutine called as a statement, never awaited

@@ -1,0 +1,19 @@
+"""RC004 good: handles kept (cancellable, exceptions retrievable)."""
+import asyncio
+
+
+async def job():
+    await asyncio.sleep(0)
+
+
+class Runner:
+    def __init__(self):
+        self._tasks = set()
+
+    async def kick(self):
+        t = asyncio.create_task(job())  # no finding: handle kept
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+
+    async def direct(self):
+        await job()  # no finding: awaited

@@ -1,0 +1,14 @@
+"""RC005 bad: loop-affine asyncio API touched from a pure-thread path."""
+import asyncio
+import threading
+
+
+class Bridge:
+    def __init__(self):
+        self._q = asyncio.Queue()
+        self._t = threading.Thread(target=self._feed)
+
+    def _feed(self):
+        self._q.put_nowait(1)  # RC005: asyncio.Queue is not thread-safe
+        loop = asyncio.get_event_loop()  # RC005: loop-affine lookup
+        return loop

@@ -1,0 +1,17 @@
+"""RC005 good: the sanctioned thread->loop boundaries."""
+import asyncio
+import queue
+import threading
+
+
+class Bridge:
+    def __init__(self, loop):
+        self._q = asyncio.Queue()
+        self._out = queue.Queue()
+        self._loop = loop
+        self._t = threading.Thread(target=self._feed)
+
+    def _feed(self):
+        # no finding: call_soon_threadsafe IS the boundary
+        self._loop.call_soon_threadsafe(self._q.put_nowait, 1)
+        self._out.put(1)  # no finding: queue.Queue is thread-safe

@@ -1,0 +1,232 @@
+"""Regression tests for the concurrency fixes the RC sweep produced.
+
+Each test pins one fix from the ISSUE 17 audit:
+
+* ws/hub.py — writer/cleanup/stats task crashes are retrieved and
+  logged by ``_retrieve`` instead of dying as 'exception was never
+  retrieved' at GC time.
+* mempool/intake.py — a drainer crash outside ``_process``'s per-request
+  catch is logged by the done-callback, not silently respawned over.
+* snapshot/client.py — journal/file work runs off the event loop via
+  ``_io`` (sqlite + fsync on the loop thread stalled gossip during
+  restores).
+* snapshot/builder.py — the durable write half (``_write_generation``)
+  runs in an executor, and a crashed build still sweeps its staging dir.
+* node/app.py — /debug/profile dispatches the jax.profiler calls via
+  run_in_executor (a cold profiler start blocked the loop for seconds;
+  found live by the sanitizer under tier-1).
+"""
+
+import asyncio
+import logging
+import threading
+
+import pytest
+
+from upow_tpu.snapshot import builder, client, layout
+
+from test_snapshot import DiskSource, _populated_state  # noqa: F401
+from test_wallet import easy_difficulty  # noqa: F401  (autouse fixture)
+from upow_tpu.state import ChainState
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture()
+def propagating_logs():
+    """setup_logging() sets propagate=False on the package logger once a
+    node has booted anywhere in the session; re-enable it so caplog's
+    root handler sees the records these tests assert on."""
+    root = logging.getLogger("upow_tpu")
+    prev = root.propagate
+    root.propagate = True
+    try:
+        yield
+    finally:
+        root.propagate = prev
+
+
+# ------------------------------------------------------------- ws/hub.py --
+
+def test_hub_retrieve_logs_crashed_task(caplog, propagating_logs):
+    from upow_tpu.ws import hub as hub_mod
+
+    async def main():
+        async def boom():
+            raise RuntimeError("writer down")
+
+        t = asyncio.get_running_loop().create_task(boom())
+        await asyncio.gather(t, return_exceptions=True)
+        hub_mod._retrieve(t, "writer")
+
+    with caplog.at_level(logging.ERROR):
+        run(main())
+    assert "writer task died" in caplog.text
+    assert "writer down" in caplog.text
+
+
+def test_hub_retrieve_ignores_cancellation(caplog, propagating_logs):
+    """Cancellation is the normal unregister path — no error logged,
+    and no CancelledError re-raised out of the done-callback."""
+    from upow_tpu.ws import hub as hub_mod
+
+    async def main():
+        t = asyncio.get_running_loop().create_task(asyncio.sleep(30))
+        t.cancel()
+        await asyncio.gather(t, return_exceptions=True)
+        hub_mod._retrieve(t, "writer")
+
+    with caplog.at_level(logging.ERROR):
+        run(main())
+    assert "task died" not in caplog.text
+
+
+def test_hub_wires_done_callbacks_on_writer_tasks():
+    """_register must attach the retrieval callback to every writer
+    task it spawns (the wiring, not just the helper)."""
+    import inspect
+
+    from upow_tpu.ws.hub import WsHub
+
+    src = inspect.getsource(WsHub._register)
+    assert "add_done_callback" in src
+    src = inspect.getsource(WsHub._ensure_loops)
+    assert "add_done_callback" in src
+
+
+# ------------------------------------------------------ mempool/intake.py --
+
+def test_intake_drainer_crash_is_logged(caplog, propagating_logs):
+    from upow_tpu.mempool import intake as intake_mod
+
+    async def main():
+        async def dying_drainer():
+            raise RuntimeError("drainer down")
+
+        t = asyncio.get_running_loop().create_task(dying_drainer())
+        t.add_done_callback(intake_mod._log_drainer_exit)
+        await asyncio.gather(t, return_exceptions=True)
+        await asyncio.sleep(0)  # let the callback run
+
+    with caplog.at_level(logging.ERROR):
+        run(main())
+    assert "drainer died" in caplog.text
+
+
+def test_intake_drainer_cancel_is_silent(caplog, propagating_logs):
+    from upow_tpu.mempool import intake as intake_mod
+
+    async def main():
+        t = asyncio.get_running_loop().create_task(asyncio.sleep(30))
+        t.add_done_callback(intake_mod._log_drainer_exit)
+        t.cancel()
+        await asyncio.gather(t, return_exceptions=True)
+        await asyncio.sleep(0)
+
+    with caplog.at_level(logging.ERROR):
+        run(main())
+    assert "drainer died" not in caplog.text
+
+
+# ---------------------------------------------------------- node/app.py --
+
+def test_debug_profile_handler_dispatches_off_loop():
+    """The profiler control calls must ride an executor — a cold
+    jax.profiler.start_trace initializes the plugin and blocks for
+    seconds, stalling every request on the node's loop."""
+    import inspect
+
+    from upow_tpu.node.app import Node
+
+    src = inspect.getsource(Node.h_debug_profile)
+    assert "run_in_executor" in src
+    assert "profiling.start" in src
+
+
+# ----------------------------------------------------- snapshot/client.py --
+
+def test_snapshot_client_io_runs_off_loop():
+    async def main():
+        loop_thread = threading.current_thread()
+        worker = await client._io(threading.current_thread)
+        assert worker is not loop_thread
+        # positional args pass through
+        assert await client._io(lambda a, b: a + b, 2, 3) == 5
+
+    run(main())
+
+
+def test_restore_journal_work_stays_off_loop(tmp_path, monkeypatch):
+    """During a real restore every journal commit runs on an executor
+    thread — the sqlite+fsync work that used to stall the loop."""
+    seen = []
+    real = client._Journal.commit_chunk
+
+    def spy(self, i, data):
+        seen.append(threading.current_thread())
+        return real(self, i, data)
+
+    monkeypatch.setattr(client._Journal, "commit_chunk", spy)
+
+    async def main():
+        state = await _populated_state()
+        root = str(tmp_path / "server")
+        await builder.build_snapshot(state, root, chunk_bytes=512)
+        joiner = ChainState()
+        await client.bootstrap_from_snapshot(
+            joiner, [DiskSource(root)], str(tmp_path / "joiner"))
+        loop_thread = threading.current_thread()
+        assert seen
+        assert all(t is not loop_thread for t in seen)
+        assert await joiner.get_unspent_outputs_hash() == \
+            await state.get_unspent_outputs_hash()
+        state.close()
+        joiner.close()
+
+    run(main())
+
+
+# ---------------------------------------------------- snapshot/builder.py --
+
+def test_builder_write_phase_runs_off_loop(tmp_path, monkeypatch):
+    seen = {}
+    real = builder._write_generation
+
+    def spy(*args, **kw):
+        seen["thread"] = threading.current_thread()
+        return real(*args, **kw)
+
+    monkeypatch.setattr(builder, "_write_generation", spy)
+
+    async def main():
+        state = await _populated_state(blocks=2)
+        await builder.build_snapshot(state, str(tmp_path), chunk_bytes=512)
+        assert seen["thread"] is not threading.current_thread()
+        assert layout.current_manifest(str(tmp_path)) is not None
+        state.close()
+
+    run(main())
+
+
+def test_builder_crash_sweeps_staging(tmp_path, monkeypatch):
+    """A build that dies mid-write leaves no staging litter behind (the
+    executor refactor kept the cleanup path)."""
+
+    def explode(*args, **kw):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(builder, "_write_generation", explode)
+
+    async def main():
+        state = await _populated_state(blocks=2)
+        with pytest.raises(OSError):
+            await builder.build_snapshot(state, str(tmp_path),
+                                         chunk_bytes=512)
+        leftovers = [p for p in tmp_path.iterdir()
+                     if p.name.startswith(".staging-")]
+        assert leftovers == []
+        state.close()
+
+    run(main())

@@ -190,6 +190,178 @@ def test_device_purity_scope_excludes_device_dir(tmp_path):
     assert run_lint([str(f)]).findings == []
 
 
+# --- race/concurrency family (RC, interprocedural) -----------------------
+
+CONCURRENCY = FIXTURES / "concurrency"
+
+
+def test_rc001_transitive_blocking_fires():
+    result, fired = rules_fired(CONCURRENCY / "rc001_bad.py",
+                                select={"RC"})
+    assert fired == {"RC001"}
+    msgs = {f.line: f.message for f in result.findings}
+    # the transitive finding is reported at the LEAF blocking call with
+    # the async chain spelled out in the message
+    src = (CONCURRENCY / "rc001_bad.py").read_text().splitlines()
+    leaf = next(line for line, m in msgs.items() if "open()" in m)
+    assert "with open(path)" in src[leaf - 1]
+    assert "handler via warm_cache → read_config" in msgs[leaf]
+    # depth-0: time.sleep directly inside a coroutine
+    assert any("time.sleep()" in m for m in msgs.values())
+    assert sum(f.rule == "RC001" for f in result.suppressed) == 1
+
+
+def test_rc001_executor_boundaries_are_clean():
+    result, fired = rules_fired(CONCURRENCY / "rc001_good.py",
+                                select={"RC"})
+    assert fired == set()
+    assert result.suppressed == []
+
+
+def test_rc001_cross_module_needs_the_project_graph():
+    """The defining interprocedural case: the helper module alone is
+    clean; adding the async importer produces a finding IN the helper."""
+    helper = CONCURRENCY / "rc001_cross_helper.py"
+    alone, fired_alone = rules_fired(helper, select={"RC"})
+    assert fired_alone == set()
+
+    both = run_lint([str(CONCURRENCY / "rc001_cross_a.py"), str(helper)],
+                    select={"RC"})
+    assert [f.rule for f in both.findings] == ["RC001"]
+    f = both.findings[0]
+    assert f.path.endswith("rc001_cross_helper.py")
+    assert "reconnect via resync → backoff" in f.message
+
+
+def test_rc002_cross_thread_write_fires_and_lock_clears():
+    result, fired = rules_fired(CONCURRENCY / "rc002_bad.py",
+                                select={"RC"})
+    assert fired == {"RC002"}
+    f = result.findings[0]
+    assert "self.total" in f.message
+    assert "Counter.report" in f.message and "Counter._drain" in f.message
+    # __init__ writes never count as racing
+    src = (CONCURRENCY / "rc002_bad.py").read_text().splitlines()
+    assert "+=" in src[f.line - 1]
+
+    good, fired_good = rules_fired(CONCURRENCY / "rc002_good.py",
+                                   select={"RC"})
+    assert fired_good == set()
+
+
+def test_rc003_threading_lock_across_await():
+    result, fired = rules_fired(CONCURRENCY / "rc003_bad.py",
+                                select={"RC"})
+    assert fired == {"RC003"}
+    assert "'_mu'" in result.findings[0].message
+
+    good, fired_good = rules_fired(CONCURRENCY / "rc003_good.py",
+                                   select={"RC"})
+    # released-before-await and asyncio.Lock are both clean
+    assert fired_good == set()
+
+
+def test_rc004_task_leaks():
+    result, fired = rules_fired(CONCURRENCY / "rc004_bad.py",
+                                select={"RC"})
+    assert fired == {"RC004"}
+    msgs = [f.message for f in result.findings]
+    assert any("result dropped" in m for m in msgs)
+    assert any("never awaited" in m for m in msgs)
+
+    good, fired_good = rules_fired(CONCURRENCY / "rc004_good.py",
+                                   select={"RC"})
+    assert fired_good == set()
+
+
+def test_rc005_loop_affinity_from_threads():
+    result, fired = rules_fired(CONCURRENCY / "rc005_bad.py",
+                                select={"RC"})
+    assert fired == {"RC005"}
+    msgs = [f.message for f in result.findings]
+    assert any("put_nowait" in m for m in msgs)
+    assert any("get_event_loop" in m for m in msgs)
+
+    good, fired_good = rules_fired(CONCURRENCY / "rc005_good.py",
+                                   select={"RC"})
+    # call_soon_threadsafe and queue.Queue are the sanctioned boundaries
+    assert fired_good == set()
+
+
+def test_rc_family_prefix_select():
+    """--select RC expands to the whole family."""
+    result = run_lint([str(CONCURRENCY / "rc004_bad.py")], select={"RC"})
+    assert {f.rule for f in result.findings} == {"RC004"}
+    # and an exact id still narrows
+    result = run_lint([str(CONCURRENCY / "rc004_bad.py")],
+                      select={"RC001"})
+    assert result.findings == []
+
+
+def test_rc_package_tree_is_swept_to_zero():
+    """ISSUE 17 acceptance: the shipped package linted with the full RC
+    family produces zero findings (real fixes + justified suppressions)."""
+    result = run_lint([str(PACKAGE)], select={"RC"})
+    assert result.findings == [], "\n" + result.to_text()
+
+
+# --- baseline mode --------------------------------------------------------
+
+def test_baseline_records_then_masks_then_catches_new(tmp_path):
+    f = tmp_path / "svc.py"
+    f.write_text(
+        "import asyncio\n"
+        "async def a():\n"
+        "    import time\n"
+        "    time.sleep(1)\n")
+    baseline = tmp_path / "lint-baseline.json"
+
+    def cli(*argv):
+        return subprocess.run(
+            [sys.executable, "-m", "upow_tpu.lint", *argv],
+            capture_output=True, text=True, cwd=str(PACKAGE.parent))
+
+    # record: exit 0, fingerprints written
+    rec = cli(str(f), "--select", "RC", "--write-baseline", str(baseline))
+    assert rec.returncode == 0, rec.stdout + rec.stderr
+    payload = json.loads(baseline.read_text())
+    assert payload["version"] == 1 and payload["fingerprints"]
+
+    # same tree against the baseline: old finding masked, exit 0
+    masked = cli(str(f), "--select", "RC", "--baseline", str(baseline))
+    assert masked.returncode == 0, masked.stdout + masked.stderr
+    assert "1 baselined" in masked.stdout
+
+    # introduce a NEW finding: only it gates
+    f.write_text(f.read_text() +
+                 "async def b():\n"
+                 "    open('/etc/hosts').read()\n")
+    fresh = cli(str(f), "--select", "RC", "--baseline", str(baseline))
+    assert fresh.returncode == 1
+    assert "open()" in fresh.stdout
+    assert "time.sleep" not in fresh.stdout.replace("1 baselined", "")
+
+
+def test_baseline_fingerprints_survive_line_moves(tmp_path):
+    """Fingerprints hash (path, rule, line text) — inserting lines above
+    a baselined finding must not resurrect it."""
+    f = tmp_path / "svc.py"
+    f.write_text("import time\nasync def a():\n    time.sleep(1)\n")
+    baseline = tmp_path / "b.json"
+    run_result = subprocess.run(
+        [sys.executable, "-m", "upow_tpu.lint", str(f), "--select", "RC",
+         "--write-baseline", str(baseline)],
+        capture_output=True, text=True, cwd=str(PACKAGE.parent))
+    assert run_result.returncode == 0
+    f.write_text("import time\n\n\n# moved\nasync def a():\n"
+                 "    time.sleep(1)\n")
+    moved = subprocess.run(
+        [sys.executable, "-m", "upow_tpu.lint", str(f), "--select", "RC",
+         "--baseline", str(baseline)],
+        capture_output=True, text=True, cwd=str(PACKAGE.parent))
+    assert moved.returncode == 0, moved.stdout
+
+
 # --- engine contract -----------------------------------------------------
 
 def test_suppress_all_keyword(tmp_path):
@@ -239,7 +411,8 @@ def test_cli_list_rules():
         capture_output=True, text=True, cwd=str(PACKAGE.parent))
     assert proc.returncode == 0
     for rule_id in ("CE001", "CP001", "JP001", "DT001", "AS001", "BE001",
-                    "DR001", "DR002", "DR003"):
+                    "DR001", "DR002", "DR003", "RC001", "RC002", "RC003",
+                    "RC004", "RC005"):
         assert rule_id in proc.stdout
 
 

@@ -77,7 +77,9 @@ def probe_platform_detail(timeout: float = 90.0) -> dict:
     # probe outcomes; jax.devices() here IS the probe the runtime arms
     # through, not a stray dispatch
     t0 = time.perf_counter()
-    status, value = boxed_call(  # upowlint: disable=DR002
+    # RC001: loop-reachable only via Node.__init__'s one-time cached
+    # device probe at startup, before the node serves traffic
+    status, value = boxed_call(  # upowlint: disable=DR002,RC001
         lambda: jax.devices()[0].platform, timeout)  # upowlint: disable=DR001
     detail = {"status": status, "platform": None,
               "seconds": round(time.perf_counter() - t0, 3),

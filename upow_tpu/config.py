@@ -405,7 +405,9 @@ class Config:
         """
         cfg = cls()
         if path and os.path.exists(path):
-            with open(path) as f:
+            # RC001: config is a one-time startup read, before the
+            # event loop serves any traffic
+            with open(path) as f:  # upowlint: disable=RC001
                 cfg = _merge_dict(cfg, json.load(f))
         cfg = _merge_env(cfg)
         for key, value in overrides.items():

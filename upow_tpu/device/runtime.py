@@ -41,6 +41,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import os
+import sys
 import threading
 import time
 from collections import deque
@@ -55,6 +56,17 @@ from ..telemetry import metrics
 log = get_logger("device.runtime")
 
 
+def _sanitizer_check(site: str) -> None:
+    """Thread-affinity assertion at the submit/drain seam: under the
+    test/CI concurrency sanitizer, a blocking boxed wait entered from
+    an event-loop thread is recorded as a finding.  The sanitizer
+    module is imported lazily so plain production imports pay nothing;
+    once imported, the inactive path is a single None check."""
+    sanitizer = sys.modules.get("upow_tpu.lint.sanitizer")
+    if sanitizer is not None:
+        sanitizer.check_blocking_wait(f"device.runtime.{site}")
+
+
 def boxed_call(fn: Callable[[], Any], timeout: float):
     """Run ``fn`` on a daemon thread with a deadline.
 
@@ -65,6 +77,8 @@ def boxed_call(fn: Callable[[], Any], timeout: float):
     and the caller decides what degraded mode means.
     """
     import contextvars
+
+    _sanitizer_check("boxed_call")
 
     box: dict = {}
     # carry the caller's contextvars into the worker so telemetry
@@ -386,6 +400,7 @@ class DeviceRuntime:
         boxed_call, but serialized through the device owner.  The safety
         margin on the outer wait covers arm + queue time; if even that
         is exceeded the caller sees a plain timeout."""
+        _sanitizer_check("run_boxed")
         fut = self.submit_call(fn, kernel=kernel, source=source,
                                timeout=timeout)
         try:

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
@@ -22,7 +23,17 @@ def main(argv=None) -> int:
         help="output format (json includes suppressed findings)")
     parser.add_argument(
         "--select", default=None, metavar="RULES",
-        help="comma-separated rule ids to run (e.g. CE001,JP001)")
+        help="comma-separated rule ids or family prefixes to run "
+             "(e.g. CE001,JP001 or RC)")
+    parser.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="known-findings file (from --write-baseline); matching "
+             "findings are reported as baselined and do not gate the "
+             "exit code")
+    parser.add_argument(
+        "--write-baseline", default=None, metavar="FILE",
+        help="record every current finding's fingerprint to FILE and "
+             "exit 0 (see docs/STATIC_ANALYSIS.md, baseline workflow)")
     parser.add_argument(
         "--list-rules", action="store_true",
         help="print the rule catalogue and exit")
@@ -37,7 +48,35 @@ def main(argv=None) -> int:
     select = None
     if args.select:
         select = {r.strip() for r in args.select.split(",") if r.strip()}
-    result = run_lint(paths, select=select)
+
+    baseline = None
+    if args.baseline:
+        try:
+            with open(args.baseline, "r", encoding="utf-8") as fh:
+                data = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"upowlint: cannot read baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        baseline = data.get("fingerprints", data) \
+            if isinstance(data, dict) else {}
+
+    result = run_lint(paths, select=select, baseline=baseline)
+
+    if args.write_baseline:
+        payload = {
+            "version": 1,
+            "select": sorted(select) if select else None,
+            "fingerprints": result.fingerprint_counts,
+        }
+        with open(args.write_baseline, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"upowlint: baseline with "
+              f"{sum(result.fingerprint_counts.values())} finding(s) "
+              f"written to {args.write_baseline}")
+        return 0
+
     print(result.to_json() if args.format == "json" else result.to_text())
     return result.exit_code
 

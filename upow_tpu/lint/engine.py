@@ -18,6 +18,26 @@ A rule is an object with:
 * ``check(ctx)``  — yields ``(line, col, message)`` tuples (the engine
   attaches path/rule/severity and applies suppressions).
 
+Project-scope rules (the RC family) additionally set
+``requires_project = True`` and implement ``check_project(project)``,
+yielding ``(rel, line, col, message)`` tuples over the whole linted set;
+the engine builds one :class:`upow_tpu.lint.project.ProjectContext`
+(symbol table + call graph + loop/thread coloring) per run — lazily, only
+when a selected rule asks for it — and applies each file's scope and
+suppressions to the findings exactly as for file rules.  Every file rule
+sees the same context at ``ctx.project`` (``None`` unless built).
+
+``--select`` accepts exact ids (``DR002``) and family prefixes (``RC``).
+
+Baseline mode
+-------------
+``run_lint(..., baseline=...)`` takes a mapping of finding fingerprints
+(see :func:`fingerprint`) to allowed counts; matching findings move to
+``result.baselined`` and stop gating the exit code, so a new rule family
+can land before the tree is swept.  Fingerprints hash the lint-root
+relative path, rule id, and the stripped source line text — stable across
+reordering, invalidated when the flagged line actually changes.
+
 Suppression
 -----------
 ``# upowlint: disable=CE001`` (comma-separated list, or ``all``) on the
@@ -29,12 +49,14 @@ above — that convention is reviewed, not machine-enforced.
 from __future__ import annotations
 
 import ast
+import hashlib
 import json
 import re
 import tokenize
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Set, \
+    Tuple
 
 SEVERITY_ERROR = "error"
 SEVERITY_WARNING = "warning"
@@ -69,6 +91,7 @@ class FileContext:
     tree: ast.Module
     source: str
     lines: List[str] = field(default_factory=list)
+    project: Optional[object] = None   # ProjectContext when built
 
 
 @dataclass
@@ -76,6 +99,8 @@ class LintResult:
     findings: List[Finding]
     suppressed: List[Finding]
     files_scanned: int
+    baselined: List[Finding] = field(default_factory=list)
+    fingerprint_counts: Dict[str, int] = field(default_factory=dict)
 
     @property
     def errors(self) -> List[Finding]:
@@ -96,9 +121,11 @@ class LintResult:
                 "error": len(self.errors),
                 "warning": len(self.warnings),
                 "suppressed": len(self.suppressed),
+                "baselined": len(self.baselined),
             },
             "findings": [f.as_dict() for f in self.findings],
             "suppressed": [f.as_dict() for f in self.suppressed],
+            "baselined": [f.as_dict() for f in self.baselined],
         }, indent=2)
 
     def to_text(self) -> str:
@@ -110,6 +137,7 @@ class LintResult:
             f"upowlint: {len(self.errors)} error(s), "
             f"{len(self.warnings)} warning(s), "
             f"{len(self.suppressed)} suppressed, "
+            f"{len(self.baselined)} baselined, "
             f"{self.files_scanned} file(s) scanned")
         return "\n".join(out)
 
@@ -188,18 +216,36 @@ def parse_suppressions(source: str) -> Dict[int, Set[str]]:
     return out
 
 
+def _rule_selected(rule_id: str, select: Set[str]) -> bool:
+    """Exact id (``DR002``) or family-prefix (``RC``) match."""
+    return any(rule_id == s or rule_id.startswith(s) for s in select)
+
+
+def fingerprint(rel: str, rule: str, line_text: str) -> str:
+    """Stable identity of a finding for baseline mode: lint-root
+    relative path + rule id + the stripped source line.  Survives the
+    file moving up or down; breaks (on purpose) when the flagged line
+    itself is edited."""
+    raw = f"{rel}|{rule}|{line_text.strip()}"
+    return hashlib.sha256(raw.encode("utf-8")).hexdigest()[:16]
+
+
 def run_lint(paths: Sequence[str], rules: Optional[Sequence] = None,
-             select: Optional[Set[str]] = None) -> LintResult:
+             select: Optional[Set[str]] = None,
+             baseline: Optional[Mapping[str, int]] = None) -> LintResult:
     """Run ``rules`` (default: the full registry) over ``paths``."""
     if rules is None:
         from .rules import ALL_RULES
 
         rules = ALL_RULES
     if select:
-        rules = [r for r in rules if r.rule_id in select]
+        rules = [r for r in rules if _rule_selected(r.rule_id, select)]
     findings: List[Finding] = []
     suppressed: List[Finding] = []
     files = discover(paths)
+
+    # Pass 1: parse everything (project rules need the full set).
+    contexts: List[FileContext] = []
     for path in files:
         try:
             source = path.read_text(encoding="utf-8")
@@ -210,23 +256,81 @@ def run_lint(paths: Sequence[str], rules: Optional[Sequence] = None,
                 SEVERITY_ERROR, f"file does not parse: {e.msg if hasattr(e, 'msg') else e}"))
             continue
         rel, parts = relative_parts(path)
-        ctx = FileContext(path=path, rel=rel, parts=parts, tree=tree,
-                          source=source, lines=source.splitlines())
-        per_line = parse_suppressions(source)
+        contexts.append(FileContext(
+            path=path, rel=rel, parts=parts, tree=tree, source=source,
+            lines=source.splitlines()))
+
+    project = None
+    project_rules = [r for r in rules
+                     if getattr(r, "requires_project", False)]
+    if project_rules:
+        from .project import ProjectContext
+
+        project = ProjectContext.build(contexts)
+
+    by_rel: Dict[str, FileContext] = {}
+    suppressions: Dict[str, Dict[int, Set[str]]] = {}
+    for ctx in contexts:
+        ctx.project = project
+        by_rel[ctx.rel] = ctx
+        suppressions[ctx.rel] = parse_suppressions(ctx.source)
+
+    def emit(ctx: FileContext, rule, line: int, col: int,
+             message: str) -> None:
+        f = Finding(str(ctx.path), line, col, rule.rule_id,
+                    rule.severity, message)
+        disabled = suppressions[ctx.rel].get(line, set())
+        if "*" in disabled or rule.rule_id in disabled:
+            suppressed.append(f)
+        else:
+            findings.append(f)
+
+    # Pass 2: file rules.
+    for ctx in contexts:
         for rule in rules:
-            if not rule.scope(parts):
+            if not rule.scope(ctx.parts):
                 continue
             for line, col, message in rule.check(ctx):
-                f = Finding(str(path), line, col, rule.rule_id,
-                            rule.severity, message)
-                disabled = per_line.get(line, set())
-                if "*" in disabled or rule.rule_id in disabled:
-                    suppressed.append(f)
-                else:
-                    findings.append(f)
+                emit(ctx, rule, line, col, message)
+
+    # Pass 3: project rules (one traversal each, findings routed back
+    # through the owning file's scope + suppressions).
+    for rule in project_rules:
+        for rel, line, col, message in rule.check_project(project):
+            ctx = by_rel.get(rel)
+            if ctx is None or not rule.scope(ctx.parts):
+                continue
+            emit(ctx, rule, line, col, message)
+
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    # Fingerprints (always computed: --write-baseline reads them).
+    rel_by_path = {str(c.path): c.rel for c in contexts}
+    lines_by_path = {str(c.path): c.lines for c in contexts}
+    fp_counts: Dict[str, int] = {}
+    fps: List[str] = []
+    for f in findings:
+        lines = lines_by_path.get(f.path, [])
+        text = lines[f.line - 1] if 1 <= f.line <= len(lines) else ""
+        fp = fingerprint(rel_by_path.get(f.path, f.path), f.rule, text)
+        fps.append(fp)
+        fp_counts[fp] = fp_counts.get(fp, 0) + 1
+
+    baselined: List[Finding] = []
+    if baseline:
+        used: Dict[str, int] = {}
+        kept: List[Finding] = []
+        for f, fp in zip(findings, fps):
+            if used.get(fp, 0) < int(baseline.get(fp, 0)):
+                used[fp] = used.get(fp, 0) + 1
+                baselined.append(f)
+            else:
+                kept.append(f)
+        findings = kept
+
     return LintResult(findings=findings, suppressed=suppressed,
-                      files_scanned=len(files))
+                      files_scanned=len(files), baselined=baselined,
+                      fingerprint_counts=fp_counts)
 
 
 # --- shared AST helpers used by several rule modules ----------------------

@@ -1,0 +1,763 @@
+"""Whole-package analysis substrate for upowlint: symbol table, call
+graph, and event-loop/thread coloring.
+
+The per-file rules catch what one AST shows; the RC (race/concurrency)
+family needs what the *package* shows: which functions run on the
+asyncio event loop, which run on background threads, and where those
+worlds touch the same state.  This module builds that picture once per
+lint run:
+
+* **Symbol table** — every function/method in the linted set, keyed by
+  ``"<rel-path>::<qualname>"`` (nested defs included), plus per-class
+  attribute *types* inferred from ``self.x = threading.Lock()``-style
+  constructor assignments (locks, asyncio queues/events, executors).
+* **Call graph** — call sites resolved through import aliases
+  (``from ..verify import txverify`` → ``verify/txverify.py`` defs),
+  ``self.meth`` dispatch (with by-name base-class lookup), local
+  nested defs, and ``Class(...)`` → ``__init__``.  Unresolvable calls
+  (dynamic dispatch, third-party code) produce no edge — the analysis
+  is deliberately under-approximate, never speculative.
+* **Coloring** — ``LOOP`` seeds at every ``async def``; ``THREAD``
+  seeds at every function handed to a thread boundary
+  (``threading.Thread(target=...)``, ``boxed_call``/``run_boxed``/
+  ``submit_call``, ``run_in_executor``, ``asyncio.to_thread``,
+  executor ``.submit``).  Colors propagate along *plain* call edges to
+  a fixpoint; boundary calls do NOT propagate LOOP into their target
+  (that is the point of the boundary).
+
+Everything here is stdlib-``ast`` only, like the rest of the linter.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+LOOP = "loop"
+THREAD = "thread"
+
+# ---------------------------------------------------------------------------
+# Knowledge bases shared by the AS and RC rule families.
+# ---------------------------------------------------------------------------
+
+#: The original AS001 table: calls that block the event loop, flagged
+#: lexically inside ``async def`` bodies in node/ws.
+AS_BLOCKING: Dict[str, str] = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "urllib.request.urlopen": "use the shared aiohttp session",
+    "socket.create_connection": "use asyncio streams / aiohttp",
+    "socket.getaddrinfo": "use loop.getaddrinfo",
+    "subprocess.run": "use asyncio.create_subprocess_exec",
+    "subprocess.call": "use asyncio.create_subprocess_exec",
+    "subprocess.check_call": "use asyncio.create_subprocess_exec",
+    "subprocess.check_output": "use asyncio.create_subprocess_exec",
+    "subprocess.Popen": "use asyncio.create_subprocess_exec",
+    "os.system": "use asyncio.create_subprocess_shell",
+}
+
+#: RC001's superset: adds file I/O (invisible at µs scale, lethal at
+#: fsync/GiB scale) and blocking cross-thread waits.  Deliberately does
+#: NOT list sqlite3 — the state backend runs synchronous sqlite inside
+#: async methods by documented design (state/storage.py).
+BLOCKING_CALLS: Dict[str, str] = dict(AS_BLOCKING)
+BLOCKING_CALLS.update({
+    "open": "move file I/O to run_in_executor",
+    "os.fsync": "run the durable write in an executor",
+    "os.replace": "run the journal commit in an executor",
+    "shutil.rmtree": "run tree removal in an executor",
+    "shutil.copytree": "run the copy in an executor",
+    "shutil.copyfileobj": "run the copy in an executor",
+})
+
+#: Bare method names that block the calling thread waiting on another
+#: thread; matched on the last dotted segment so receiver spelling
+#: (``runtime.run_boxed`` / ``self._rt.boxed_call``) does not matter.
+BLOCKING_WAIT_METHODS: Dict[str, str] = {
+    "boxed_call": "boxed_call joins a worker thread; await an "
+                  "executor-wrapped call instead",
+    "run_boxed": "run_boxed blocks on the drainer; route through "
+                 "run_in_executor from coroutine context",
+}
+
+BLOCKING_PREFIXES: Tuple[str, ...] = ("requests.",)
+
+
+def blocking_reason(canon: str) -> Optional[str]:
+    """Why ``canon`` (a canonicalized call name) blocks, or None."""
+    if canon in BLOCKING_CALLS:
+        return BLOCKING_CALLS[canon]
+    for prefix in BLOCKING_PREFIXES:
+        if canon.startswith(prefix):
+            return "use the shared aiohttp session"
+    last = canon.rsplit(".", 1)[-1]
+    if last in BLOCKING_WAIT_METHODS:
+        return BLOCKING_WAIT_METHODS[last]
+    return None
+
+
+#: Thread boundaries: call name (canonical, or a bare method name) ->
+#: position of the callable argument ("target" = Thread's keyword).
+SPAWN_APIS: Dict[str, object] = {
+    "threading.Thread": "target",
+    "asyncio.to_thread": 0,
+    "boxed_call": 0,
+    "run_boxed": 0,
+    "submit_call": 0,
+    "run_in_executor": 1,           # loop.run_in_executor(None, fn)
+    "submit": 0,                    # only on executor-typed receivers
+}
+
+#: APIs that legitimately carry work or results across the thread/loop
+#: boundary; calls to these are exempt from RC005.
+BOUNDARY_APIS = {
+    "call_soon_threadsafe",
+    "run_coroutine_threadsafe",
+    "run_in_executor",
+    "to_thread",
+}
+
+#: Constructor canonical name -> attribute type tag.
+ATTR_CTORS: Dict[str, str] = {
+    "threading.Lock": "lock",
+    "threading.RLock": "lock",
+    "threading.Condition": "lock",
+    "asyncio.Lock": "async_lock",
+    "asyncio.Condition": "async_lock",
+    "asyncio.Semaphore": "async_lock",
+    "asyncio.Queue": "asyncio_queue",
+    "asyncio.LifoQueue": "asyncio_queue",
+    "asyncio.PriorityQueue": "asyncio_queue",
+    "asyncio.Event": "asyncio_event",
+    "threading.Event": "mt_event",
+    "queue.Queue": "mt_queue",
+    "queue.SimpleQueue": "mt_queue",
+    "collections.deque": "deque",
+    "concurrent.futures.ThreadPoolExecutor": "executor",
+    "concurrent.futures.ProcessPoolExecutor": "executor",
+}
+
+LOCK_KINDS = {"lock"}
+
+#: asyncio surfaces that are loop-affine: touching them from a plain
+#: thread either raises far away or silently targets the wrong loop.
+LOOP_AFFINE_CALLS: Dict[str, str] = {
+    "asyncio.create_task": "schedule via run_coroutine_threadsafe",
+    "asyncio.ensure_future": "schedule via run_coroutine_threadsafe",
+    "asyncio.get_event_loop": "from a thread this returns/creates the "
+                              "WRONG loop; pass the loop in explicitly",
+}
+
+#: Methods on asyncio-typed attributes that are loop-affine when the
+#: caller runs on a thread.
+LOOP_AFFINE_ATTR_KINDS = {"asyncio_queue", "asyncio_event"}
+
+
+# ---------------------------------------------------------------------------
+# Data model
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CallSite:
+    name: str                   # dotted name as written ("self.flush")
+    canon: str                  # canonicalized through imports
+    lineno: int
+    col: int
+    awaited: bool = False
+    is_stmt: bool = False       # the call IS the statement (Expr node)
+    target: Optional[str] = None        # resolved fid (filled by link())
+    node: Optional[ast.Call] = None
+
+
+@dataclass
+class SpawnSite:
+    api: str                    # boundary name ("threading.Thread", ...)
+    target_name: str            # dotted name of the callable handed over
+    lineno: int
+    col: int
+    target: Optional[str] = None        # resolved fid
+
+
+@dataclass
+class AttrWrite:
+    attr: str
+    fid: str
+    lineno: int
+    col: int
+    guards: Tuple[Tuple[str, ...], ...]  # lock-ish descriptors in scope
+    in_init: bool
+
+
+@dataclass
+class HeldAwait:
+    """An ``await`` executed while a ``with <lock>`` is held inside an
+    ``async def`` (RC003 raw material)."""
+    lock: Tuple[str, ...]       # descriptor, e.g. ("self", "_lock")
+    lineno: int                 # line of the await
+    col: int
+
+
+@dataclass
+class FunctionInfo:
+    fid: str
+    rel: str
+    modkey: Tuple[str, ...]
+    name: str
+    qualname: str
+    cls: Optional[str]
+    is_async: bool
+    lineno: int
+    col: int
+    parent: Optional[str] = None
+    children: Dict[str, str] = field(default_factory=dict)
+    calls: List[CallSite] = field(default_factory=list)
+    spawns: List[SpawnSite] = field(default_factory=list)
+    held_awaits: List[HeldAwait] = field(default_factory=list)
+    local_types: Dict[str, str] = field(default_factory=dict)
+    local_ctors: Dict[str, str] = field(default_factory=dict)
+    colors: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    rel: str
+    modkey: Tuple[str, ...]
+    bases: List[str] = field(default_factory=list)
+    methods: Dict[str, str] = field(default_factory=dict)
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    attr_ctors: Dict[str, str] = field(default_factory=dict)
+    attr_writes: List[AttrWrite] = field(default_factory=list)
+
+
+@dataclass
+class ModuleInfo:
+    rel: str
+    key: Tuple[str, ...]
+    # local name -> ("ext", "dotted.name") | ("proj", modkey, symbol|None)
+    imports: Dict[str, tuple] = field(default_factory=dict)
+    functions: Dict[str, str] = field(default_factory=dict)
+    classes: Dict[str, "ClassInfo"] = field(default_factory=dict)
+
+
+class ProjectContext:
+    """The linked whole-package view handed to project-scope rules."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[Tuple[str, ...], ModuleInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[Tuple[Tuple[str, ...], str], ClassInfo] = {}
+        self._by_rel: Dict[str, ModuleInfo] = {}
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def build(cls, files: Sequence) -> "ProjectContext":
+        """``files``: FileContext-likes exposing ``rel``, ``parts``,
+        ``tree``."""
+        proj = cls()
+        for fc in files:
+            _scan_module(proj, fc.rel, fc.parts, fc.tree)
+        proj._link()
+        return proj
+
+    # -- lookups -----------------------------------------------------------
+
+    def module_for(self, rel: str) -> Optional[ModuleInfo]:
+        return self._by_rel.get(rel)
+
+    def function(self, fid: Optional[str]) -> Optional[FunctionInfo]:
+        if fid is None:
+            return None
+        return self.functions.get(fid)
+
+    def iter_functions(self) -> Iterable[FunctionInfo]:
+        return self.functions.values()
+
+    def canonical(self, modkey: Tuple[str, ...], name: str) -> str:
+        """Resolve the head of a dotted name through the module's import
+        aliases: ``th.Thread`` -> ``threading.Thread``.  Project-module
+        targets render as ``a/b.symbol`` — a spelling that cannot
+        collide with external dotted names."""
+        mod = self.modules.get(modkey)
+        if mod is None or not name:
+            return name
+        head, _, rest = name.partition(".")
+        target = mod.imports.get(head)
+        if target is None:
+            return name
+        if target[0] == "ext":
+            return target[1] + ("." + rest if rest else "")
+        modkey2, symbol = target[1], target[2]
+        base = "/".join(modkey2) + (("." + symbol) if symbol else "")
+        return base + ("." + rest if rest else "")
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve_call(self, fn: FunctionInfo, name: str) -> Optional[str]:
+        """Map a dotted call name inside ``fn`` to a function id, or
+        None when the target is outside the linted set / dynamic."""
+        if not name:
+            return None
+        parts = name.split(".")
+        mod = self.modules.get(fn.modkey)
+        if parts[0] == "self" and fn.cls and len(parts) == 2:
+            return self._resolve_method(fn.modkey, fn.cls, parts[1])
+        if parts[0] == "self" and fn.cls and len(parts) == 3:
+            # self.attr.meth() through a ctor-typed attribute
+            ctor = self._attr_ctor(fn, parts[1])
+            if ctor is not None:
+                key = self._class_key(fn.modkey, ctor)
+                if key is not None:
+                    return self._resolve_method(key[0], key[1], parts[2])
+            return None
+        if len(parts) == 2:
+            # local.meth() through a ctor-typed local variable
+            scope: Optional[FunctionInfo] = fn
+            while scope is not None:
+                if parts[0] in scope.local_ctors:
+                    key = self._class_key(
+                        fn.modkey, scope.local_ctors[parts[0]])
+                    if key is not None:
+                        return self._resolve_method(key[0], key[1],
+                                                    parts[1])
+                    break
+                scope = self.functions.get(scope.parent) \
+                    if scope.parent else None
+        if len(parts) == 1:
+            n = parts[0]
+            scope: Optional[FunctionInfo] = fn
+            while scope is not None:
+                if n in scope.children:
+                    return scope.children[n]
+                scope = self.functions.get(scope.parent) \
+                    if scope.parent else None
+            if mod is not None:
+                if n in mod.functions:
+                    return mod.functions[n]
+                if n in mod.classes:           # Class() -> __init__
+                    return self._resolve_method(fn.modkey, n, "__init__")
+                imp = mod.imports.get(n)
+                if imp is not None and imp[0] == "proj" and imp[2]:
+                    return self._resolve_in_module(imp[1], imp[2])
+            return None
+        if mod is None:
+            return None
+        imp = mod.imports.get(parts[0])
+        if imp is not None and imp[0] == "proj":
+            if imp[2] is None:
+                # module alias: txverify.fn() / txverify.Class.meth()
+                if len(parts) == 2:
+                    return self._resolve_in_module(imp[1], parts[1])
+                if len(parts) == 3:
+                    return self._resolve_method(imp[1], parts[1], parts[2])
+            elif len(parts) == 2:
+                # from .mod import Class ; Class.meth(...)
+                return self._resolve_method(imp[1], imp[2], parts[1])
+        if parts[0] in mod.classes and len(parts) == 2:
+            return self._resolve_method(fn.modkey, parts[0], parts[1])
+        return None
+
+    def _attr_ctor(self, fn: FunctionInfo, attr: str) -> Optional[str]:
+        seen: Set[Tuple[Tuple[str, ...], str]] = set()
+        stack = [(fn.modkey, fn.cls or "")]
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            ci = self.classes.get(key)
+            if ci is None:
+                continue
+            if attr in ci.attr_ctors:
+                return ci.attr_ctors[attr]
+            stack.extend((ci.modkey, b) for b in ci.bases)
+        return None
+
+    def _class_key(self, modkey: Tuple[str, ...],
+                   ctor: str) -> Optional[Tuple[Tuple[str, ...], str]]:
+        """Resolve a constructor name as written (``_Journal`` /
+        ``mod.Cls``) to the (modkey, class-name) that defines it."""
+        mod = self.modules.get(modkey)
+        if mod is None:
+            return None
+        parts = ctor.split(".")
+        if len(parts) == 1:
+            if parts[0] in mod.classes:
+                return (modkey, parts[0])
+            imp = mod.imports.get(parts[0])
+            if imp is not None and imp[0] == "proj" and imp[2]:
+                tgt = self.modules.get(imp[1])
+                if tgt is not None and imp[2] in tgt.classes:
+                    return (imp[1], imp[2])
+            return None
+        if len(parts) == 2:
+            imp = mod.imports.get(parts[0])
+            if imp is not None and imp[0] == "proj" and imp[2] is None:
+                tgt = self.modules.get(imp[1])
+                if tgt is not None and parts[1] in tgt.classes:
+                    return (imp[1], parts[1])
+        return None
+
+    def _resolve_in_module(self, modkey: Tuple[str, ...],
+                           symbol: str) -> Optional[str]:
+        mod = self.modules.get(modkey)
+        if mod is None:
+            return None
+        if symbol in mod.functions:
+            return mod.functions[symbol]
+        if symbol in mod.classes:
+            return self._resolve_method(modkey, symbol, "__init__")
+        return None
+
+    def _resolve_method(self, modkey: Tuple[str, ...], cls_name: str,
+                        meth: str, _depth: int = 0) -> Optional[str]:
+        if _depth > 8:
+            return None
+        ci = self.classes.get((modkey, cls_name))
+        if ci is None:
+            mod = self.modules.get(modkey)
+            if mod is not None:
+                imp = mod.imports.get(cls_name)
+                if imp is not None and imp[0] == "proj" and imp[2]:
+                    return self._resolve_method(imp[1], imp[2], meth,
+                                                _depth + 1)
+            return None
+        if meth in ci.methods:
+            return ci.methods[meth]
+        for base in ci.bases:
+            found = self._resolve_method(ci.modkey, base, meth, _depth + 1)
+            if found is not None:
+                return found
+        return None
+
+    def attr_type(self, fn: FunctionInfo,
+                  desc: Tuple[str, ...]) -> Optional[str]:
+        """Type tag for a descriptor: ("self", "_lock") via the
+        enclosing class (by-name base walk), ("local", name) via a
+        function-local constructor assignment."""
+        if len(desc) == 2 and desc[0] == "self" and fn.cls:
+            seen: Set[Tuple[Tuple[str, ...], str]] = set()
+            stack = [(fn.modkey, fn.cls)]
+            while stack:
+                key = stack.pop()
+                if key in seen:
+                    continue
+                seen.add(key)
+                ci = self.classes.get(key)
+                if ci is None:
+                    continue
+                if desc[1] in ci.attr_types:
+                    return ci.attr_types[desc[1]]
+                stack.extend((ci.modkey, b) for b in ci.bases)
+            return None
+        if len(desc) == 2 and desc[0] == "local":
+            scope: Optional[FunctionInfo] = fn
+            while scope is not None:
+                if desc[1] in scope.local_types:
+                    return scope.local_types[desc[1]]
+                scope = self.functions.get(scope.parent) \
+                    if scope.parent else None
+        return None
+
+    def class_of(self, fn: FunctionInfo) -> Optional[ClassInfo]:
+        if fn.cls is None:
+            return None
+        return self.classes.get((fn.modkey, fn.cls))
+
+    # -- linking & coloring ------------------------------------------------
+
+    def _link(self) -> None:
+        for fn in list(self.functions.values()):
+            for call in fn.calls:
+                call.target = self.resolve_call(fn, call.name)
+            for spawn in fn.spawns:
+                spawn.target = self.resolve_call(fn, spawn.target_name)
+        self._color()
+
+    def _color(self) -> None:
+        work: List[str] = []
+        for fn in self.functions.values():
+            if fn.is_async:
+                fn.colors.add(LOOP)
+                work.append(fn.fid)
+        for fn in self.functions.values():
+            for spawn in fn.spawns:
+                tgt = self.functions.get(spawn.target or "")
+                if tgt is not None and THREAD not in tgt.colors:
+                    tgt.colors.add(THREAD)
+                    work.append(tgt.fid)
+        # Propagate along plain call edges (caller color -> sync
+        # callee).  Async callees are independently LOOP-seeded; spawn
+        # boundaries were handled above and add only THREAD.
+        while work:
+            fid = work.pop()
+            fn = self.functions[fid]
+            for call in fn.calls:
+                tgt = self.functions.get(call.target or "")
+                if tgt is None or tgt.is_async:
+                    continue
+                added = fn.colors - tgt.colors
+                if added:
+                    tgt.colors |= added
+                    work.append(tgt.fid)
+
+
+# ---------------------------------------------------------------------------
+# Per-module scanning
+# ---------------------------------------------------------------------------
+
+def _module_key(parts: Tuple[str, ...]) -> Tuple[str, ...]:
+    """("node", "app.py") -> ("node", "app"); packages drop __init__."""
+    key = list(parts)
+    if key and key[-1].endswith(".py"):
+        key[-1] = key[-1][:-3]
+    if key and key[-1] == "__init__":
+        key = key[:-1]
+    return tuple(key)
+
+
+def _import_target(modkey: Tuple[str, ...], base: str, level: int,
+                   symbol: Optional[str]) -> tuple:
+    """Classify one import binding as project-internal (relative, or
+    absolute under ``upow_tpu.``) or external."""
+    if level > 0:
+        pkg = list(modkey[:-1]) if modkey else []
+        up = level - 1
+        if up:
+            pkg = pkg[: max(0, len(pkg) - up)]
+        target = tuple(pkg) + tuple(p for p in base.split(".") if p)
+        return ("proj", target, symbol)
+    headparts = [p for p in base.split(".") if p]
+    if headparts and headparts[0] == "upow_tpu":
+        return ("proj", tuple(headparts[1:]), symbol)
+    if symbol is None:
+        return ("ext", base, None)
+    return ("ext", (base + "." + symbol) if base else symbol, None)
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _callable_name(node: ast.AST) -> str:
+    """Name of a callable handed to a spawn API; unwraps
+    ``functools.partial(fn, ...)`` one level."""
+    if isinstance(node, ast.Call):
+        if _dotted(node.func).rsplit(".", 1)[-1] == "partial" and node.args:
+            return _callable_name(node.args[0])
+        return ""
+    return _dotted(node)
+
+
+def _scan_module(proj: ProjectContext, rel: str, parts: Tuple[str, ...],
+                 tree: ast.Module) -> None:
+    key = _module_key(parts)
+    mod = ModuleInfo(rel=rel, key=key)
+    proj.modules[key] = mod
+    proj._by_rel[rel] = mod
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    mod.imports[alias.asname] = _import_target(
+                        key, alias.name, 0, None)
+                else:
+                    head = alias.name.split(".")[0]
+                    mod.imports.setdefault(
+                        head, _import_target(key, head, 0, None))
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                local = alias.asname or alias.name
+                mod.imports[local] = _import_target(
+                    key, node.module or "", node.level, alias.name)
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_function(proj, mod, stmt, prefix="", cls=None, parent=None)
+        elif isinstance(stmt, ast.ClassDef):
+            _scan_class(proj, mod, stmt)
+
+
+def _scan_class(proj: ProjectContext, mod: ModuleInfo,
+                node: ast.ClassDef) -> None:
+    ci = ClassInfo(name=node.name, rel=mod.rel, modkey=mod.key,
+                   bases=[_dotted(b).split(".")[-1]
+                          for b in node.bases if _dotted(b)])
+    mod.classes[node.name] = ci
+    proj.classes[(mod.key, node.name)] = ci
+    for stmt in node.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            ci.methods[stmt.name] = _scan_function(
+                proj, mod, stmt, prefix=node.name + ".", cls=node.name,
+                parent=None, classinfo=ci)
+
+
+def _scan_function(proj: ProjectContext, mod: ModuleInfo, node,
+                   prefix: str, cls: Optional[str], parent: Optional[str],
+                   classinfo: Optional[ClassInfo] = None) -> str:
+    qualname = prefix + node.name
+    fid = f"{mod.rel}::{qualname}"
+    info = FunctionInfo(
+        fid=fid, rel=mod.rel, modkey=mod.key, name=node.name,
+        qualname=qualname, cls=cls,
+        is_async=isinstance(node, ast.AsyncFunctionDef),
+        lineno=node.lineno, col=node.col_offset, parent=parent)
+    proj.functions[fid] = info
+    if parent is None and cls is None:
+        mod.functions.setdefault(node.name, fid)
+
+    lock_stack: List[Tuple[str, ...]] = []
+    nested: List[ast.AST] = []
+
+    def descriptor(expr: ast.AST) -> Optional[Tuple[str, ...]]:
+        name = _dotted(expr)
+        if not name:
+            return None
+        dparts = name.split(".")
+        if dparts[0] == "self" and len(dparts) == 2:
+            return ("self", dparts[1])
+        if len(dparts) == 1:
+            return ("local", dparts[0])
+        return ("name", name)
+
+    # pre-pass: awaited calls and statement-expression calls by node id
+    awaited: Set[int] = set()
+    stmt_calls: Set[int] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Await) and isinstance(sub.value, ast.Call):
+            awaited.add(id(sub.value))
+        if isinstance(sub, ast.Expr) and isinstance(sub.value, ast.Call):
+            stmt_calls.add(id(sub.value))
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            nested.append(n)
+            return
+        if isinstance(n, (ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(n, ast.Await) and lock_stack and info.is_async:
+            for lock in list(lock_stack):
+                info.held_awaits.append(HeldAwait(
+                    lock=lock, lineno=n.lineno, col=n.col_offset))
+        if isinstance(n, ast.With):
+            pushed = 0
+            for item in n.items:
+                if isinstance(item.context_expr, ast.Call):
+                    visit(item.context_expr)
+                else:
+                    desc = descriptor(item.context_expr)
+                    if desc is not None:
+                        lock_stack.append(desc)
+                        pushed += 1
+            for child in n.body:
+                visit(child)
+            for _ in range(pushed):
+                lock_stack.pop()
+            return
+        if isinstance(n, ast.Call):
+            name = _dotted(n.func)
+            if name:
+                canon = proj.canonical(mod.key, name)
+                info.calls.append(CallSite(
+                    name=name, canon=canon, lineno=n.lineno,
+                    col=n.col_offset, awaited=id(n) in awaited,
+                    is_stmt=id(n) in stmt_calls, node=n))
+                _spawns_from_call(proj, info, n, name, canon)
+        if isinstance(n, (ast.Assign, ast.AugAssign)):
+            _record_writes(proj, mod, classinfo, info, n,
+                           tuple(lock_stack))
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    for child in node.body:
+        visit(child)
+
+    for sub in nested:
+        child_fid = _scan_function(
+            proj, mod, sub, prefix=qualname + ".<locals>.", cls=cls,
+            parent=fid, classinfo=classinfo)
+        info.children[sub.name] = child_fid
+    return fid
+
+
+def _spawns_from_call(proj: ProjectContext, info: FunctionInfo,
+                      call: ast.Call, name: str, canon: str) -> None:
+    arg_pos = None
+    api = None
+    if canon in SPAWN_APIS and canon != "submit":
+        api, arg_pos = canon, SPAWN_APIS[canon]
+    else:
+        last = name.rsplit(".", 1)[-1]
+        if last in ("boxed_call", "run_boxed", "submit_call",
+                    "run_in_executor"):
+            api, arg_pos = last, SPAWN_APIS[last]
+        elif last == "submit" and "." in name:
+            # executor.submit(fn) — only when the receiver is typed
+            recv = name.rsplit(".", 1)[0]
+            rparts = recv.split(".")
+            desc = None
+            if rparts[0] == "self" and len(rparts) == 2:
+                desc = ("self", rparts[1])
+            elif len(rparts) == 1:
+                desc = ("local", rparts[0])
+            if desc is not None and \
+                    proj.attr_type(info, desc) == "executor":
+                api, arg_pos = "submit", 0
+    if api is None:
+        return
+    target_expr = None
+    if arg_pos == "target":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target_expr = kw.value
+    elif isinstance(arg_pos, int) and len(call.args) > arg_pos:
+        target_expr = call.args[arg_pos]
+    if target_expr is None:
+        return
+    tname = _callable_name(target_expr)
+    if tname:
+        info.spawns.append(SpawnSite(api=api, target_name=tname,
+                                     lineno=call.lineno,
+                                     col=call.col_offset))
+
+
+def _record_writes(proj: ProjectContext, mod: ModuleInfo,
+                   classinfo: Optional[ClassInfo], info: FunctionInfo,
+                   node, guards: Tuple[Tuple[str, ...], ...]) -> None:
+    targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+    value = node.value
+    for tgt in targets:
+        elts = list(tgt.elts) if isinstance(tgt, ast.Tuple) else [tgt]
+        for t in elts:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self" \
+                    and classinfo is not None:
+                classinfo.attr_writes.append(AttrWrite(
+                    attr=t.attr, fid=info.fid, lineno=t.lineno,
+                    col=t.col_offset, guards=guards,
+                    in_init=info.name in ("__init__", "__post_init__")))
+                if isinstance(value, ast.Call):
+                    ctor = _dotted(value.func)
+                    canon = proj.canonical(mod.key, ctor)
+                    tag = ATTR_CTORS.get(canon)
+                    if tag is not None:
+                        classinfo.attr_types.setdefault(t.attr, tag)
+                    elif ctor:
+                        classinfo.attr_ctors.setdefault(t.attr, ctor)
+            elif isinstance(t, ast.Name) and isinstance(value, ast.Call):
+                ctor = _dotted(value.func)
+                canon = proj.canonical(mod.key, ctor)
+                tag = ATTR_CTORS.get(canon)
+                if tag is not None:
+                    info.local_types.setdefault(t.id, tag)
+                elif ctor:
+                    info.local_ctors.setdefault(t.id, ctor)

@@ -1,7 +1,7 @@
 """Rule registry. Import order fixes the --list-rules display order."""
 
-from . import (asyncsafety, broadexcept, consensus, devicepurity, dtypes,
-               endianness, jitpurity)
+from . import (asyncsafety, broadexcept, concurrency, consensus,
+               devicepurity, dtypes, endianness, jitpurity)
 
 ALL_RULES = (
     endianness.RULES
@@ -11,6 +11,7 @@ ALL_RULES = (
     + asyncsafety.RULES
     + broadexcept.RULES
     + devicepurity.RULES
+    + concurrency.RULES
 )
 
 __all__ = ["ALL_RULES"]

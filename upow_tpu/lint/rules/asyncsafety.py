@@ -12,6 +12,12 @@ AS001 flags calls to known-blocking APIs lexically inside ``async def``
 the loop thread too): ``time.sleep``, the ``requests`` package, urllib
 openers, ``socket`` connect/DNS, ``subprocess`` (use
 ``asyncio.create_subprocess_*``), and ``os.system``.
+
+The blocking-call table itself lives in :mod:`upow_tpu.lint.project`
+(``AS_BLOCKING``) and is shared with RC001, which generalizes this rule
+interprocedurally across the whole package with an extended table (file
+I/O, cross-thread joins).  AS001 stays lexical on purpose: it is the
+fast, zero-false-positive core that fires even on a single file.
 """
 
 from __future__ import annotations
@@ -20,27 +26,16 @@ import ast
 from typing import Tuple
 
 from ..engine import SEVERITY_ERROR, FileContext, dotted_name
+from ..project import AS_BLOCKING as _BLOCKING
+from ..project import BLOCKING_PREFIXES as _BLOCKING_PREFIXES
 
 _SCOPE = {"node", "ws"}
-
-_BLOCKING = {
-    "time.sleep": "use `await asyncio.sleep(...)`",
-    "urllib.request.urlopen": "use the shared aiohttp session",
-    "socket.create_connection": "use asyncio streams / aiohttp",
-    "socket.getaddrinfo": "use loop.getaddrinfo",
-    "subprocess.run": "use asyncio.create_subprocess_exec",
-    "subprocess.call": "use asyncio.create_subprocess_exec",
-    "subprocess.check_call": "use asyncio.create_subprocess_exec",
-    "subprocess.check_output": "use asyncio.create_subprocess_exec",
-    "subprocess.Popen": "use asyncio.create_subprocess_exec",
-    "os.system": "use asyncio.create_subprocess_shell",
-}
-_BLOCKING_PREFIXES = ("requests.",)
 
 
 class BlockingInAsyncRule:
     rule_id = "AS001"
     severity = SEVERITY_ERROR
+    requires_project = False    # lexical by design; RC001 generalizes it
     description = "blocking call inside async def (node/ws event loop)"
 
     def scope(self, parts: Tuple[str, ...]) -> bool:

@@ -61,6 +61,7 @@ def _handles(handler: ast.ExceptHandler) -> bool:
 class BroadExceptRule:
     rule_id = "BE001"
     severity = SEVERITY_ERROR
+    requires_project = False    # per-file lexical rule (project API opt-out)
     description = "except Exception without re-raise, log call, or capture"
 
     def scope(self, parts: Tuple[str, ...]) -> bool:

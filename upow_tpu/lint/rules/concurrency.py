@@ -1,0 +1,289 @@
+"""RC — race/concurrency rules over the whole-package call graph.
+
+The node mutates consensus-critical state from the asyncio event loop
+*and* from background threads (device-runtime drainer, ``boxed_call``
+workers, miner watchdog).  File-local rules cannot see that a coroutine
+three calls up the stack is the thing a blocking helper stalls, or that
+two writers of one attribute live in different execution worlds — so
+this family runs on the :mod:`upow_tpu.lint.project` call graph
+(``requires_project = True``; findings are yielded per file by
+``check_project``).
+
+Rules
+-----
+* **RC001** — blocking call reachable *transitively* from a coroutine.
+  Interprocedural generalization of AS001: the table adds file I/O and
+  blocking cross-thread waits (``run_boxed``/``boxed_call``), and the
+  finding is reported at the blocking call site with the async path in
+  the message.  Executor/to_thread boundaries break the path.
+* **RC002** — attribute written on both an event-loop path and a thread
+  path with at least one unguarded write (no ``with <threading lock>:``
+  around it).  ``__init__`` writes are construction, not racing.
+* **RC003** — a *threading* lock held across an ``await``: every other
+  acquirer (including thread-side ones) now waits on arbitrary loop
+  latency, and a second acquisition on the same loop deadlocks.
+* **RC004** — fire-and-forget leak: ``create_task``/``ensure_future``
+  result dropped on the floor (exceptions vanish, no cancellation
+  path), or a coroutine called as a bare statement and never awaited.
+* **RC005** — loop-affine API (``asyncio.Queue``/``Event`` attributes,
+  ``create_task``/``get_event_loop``) touched from a pure-thread
+  function; ``call_soon_threadsafe``/``run_coroutine_threadsafe`` are
+  the sanctioned boundary and exempt.
+
+Known call-graph limitations (documented in docs/STATIC_ANALYSIS.md):
+no dynamic dispatch, no decorator unwrapping, attribute receivers only
+resolve through same-class constructor assignments.  Unresolvable calls
+produce no edge — the family under-approximates rather than guesses.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Set, Tuple
+
+from ..engine import SEVERITY_ERROR
+from ..project import (
+    LOOP,
+    LOOP_AFFINE_ATTR_KINDS,
+    LOOP_AFFINE_CALLS,
+    LOCK_KINDS,
+    THREAD,
+    AS_BLOCKING,
+    ProjectContext,
+    blocking_reason,
+)
+
+#: AS001's home turf: depth-0 findings there belong to AS001, not RC001.
+_AS_SCOPE = {"node", "ws"}
+
+#: Task-spawning method names matched on the last dotted segment so
+#: ``loop.create_task`` / ``self._loop.create_task`` are caught too.
+_TASK_SPAWNERS = {"create_task", "ensure_future"}
+
+_BOUNDARY_METHODS = {"call_soon_threadsafe", "run_coroutine_threadsafe"}
+
+
+def _rc_scope(parts: Tuple[str, ...]) -> bool:
+    # Package-wide, except the linter itself (its fixtures and tables
+    # mention blocking calls by name).
+    return "lint" not in parts
+
+
+class _ProjectRule:
+    severity = SEVERITY_ERROR
+    requires_project = True
+
+    def scope(self, parts: Tuple[str, ...]) -> bool:
+        return _rc_scope(parts)
+
+    def check(self, ctx) -> Iterable:
+        # File-local pass is a no-op; everything happens in
+        # check_project once per run.
+        return ()
+
+
+class TransitiveBlockingRule(_ProjectRule):
+    rule_id = "RC001"
+    description = ("blocking call on an event-loop path "
+                   "(transitive, whole-package)")
+
+    _MAX_DEPTH = 8
+
+    def check_project(self, proj: ProjectContext):
+        memo: Dict[str, Optional[tuple]] = {}
+
+        def witness(fid: str, depth: int) -> Optional[tuple]:
+            """(rel, line, col, canon, hint, chain) of the first
+            blocking call reachable from ``fid`` via sync edges."""
+            if fid in memo:
+                return memo[fid]
+            if depth > self._MAX_DEPTH:
+                return None
+            memo[fid] = None            # cycle guard
+            fn = proj.functions[fid]
+            for call in fn.calls:
+                hint = blocking_reason(call.canon)
+                if hint:
+                    w = (fn.rel, call.lineno, call.col, call.canon, hint,
+                         (fn.qualname,))
+                    memo[fid] = w
+                    return w
+            for call in fn.calls:
+                tgt = proj.function(call.target)
+                if tgt is None or tgt.is_async:
+                    continue
+                w = witness(tgt.fid, depth + 1)
+                if w is not None:
+                    w2 = w[:5] + ((fn.qualname,) + w[5],)
+                    memo[fid] = w2
+                    return w2
+            return None
+
+        seen: Set[Tuple[str, int, int]] = set()
+        for fn in sorted(proj.iter_functions(), key=lambda f: f.fid):
+            if not fn.is_async:
+                continue
+            for call in fn.calls:
+                hint = blocking_reason(call.canon)
+                if hint and not call.awaited:
+                    # depth 0: AS001 already owns its own table in
+                    # node/ws; RC001 adds the extended entries there
+                    # and everything elsewhere.
+                    parts = tuple(fn.rel.split("/"))
+                    if call.canon in AS_BLOCKING and \
+                            set(parts[:-1]) & _AS_SCOPE:
+                        continue
+                    key = (fn.rel, call.lineno, call.col)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield (fn.rel, call.lineno, call.col,
+                           f"blocking {call.canon}() inside async "
+                           f"{fn.qualname} stalls the event loop — {hint}")
+                    continue
+                tgt = proj.function(call.target)
+                if tgt is None or tgt.is_async:
+                    continue
+                w = witness(tgt.fid, 1)
+                if w is None:
+                    continue
+                rel, line, col, canon, hint, chain = w
+                key = (rel, line, col)
+                if key in seen:
+                    continue
+                seen.add(key)
+                path = " → ".join(chain)
+                yield (rel, line, col,
+                       f"blocking {canon}() reached from async "
+                       f"{fn.qualname} via {path} — {hint} (or cross the "
+                       f"boundary with run_in_executor/to_thread)")
+
+
+class CrossThreadWriteRule(_ProjectRule):
+    rule_id = "RC002"
+    description = ("attribute written on both loop and thread paths "
+                   "without a lock")
+
+    def check_project(self, proj: ProjectContext):
+        for (modkey, _name), ci in sorted(proj.classes.items(),
+                                          key=lambda kv: kv[1].rel):
+            by_attr: Dict[str, list] = {}
+            for w in ci.attr_writes:
+                if w.in_init:
+                    continue
+                if ci.attr_types.get(w.attr) is not None:
+                    continue        # lock/queue/executor plumbing itself
+                by_attr.setdefault(w.attr, []).append(w)
+            for attr, writes in sorted(by_attr.items()):
+                loop_side, thread_side, unguarded = [], [], []
+                for w in writes:
+                    fn = proj.function(w.fid)
+                    if fn is None:
+                        continue
+                    guarded = any(
+                        proj.attr_type(fn, g) in LOCK_KINDS
+                        for g in w.guards)
+                    if LOOP in fn.colors:
+                        loop_side.append((w, fn, guarded))
+                    if THREAD in fn.colors:
+                        thread_side.append((w, fn, guarded))
+                    if not guarded and fn.colors:
+                        unguarded.append((w, fn))
+                if not loop_side or not thread_side or not unguarded:
+                    continue
+                w, fn = unguarded[0]
+                loop_fn = loop_side[0][1].qualname
+                thread_fn = thread_side[0][1].qualname
+                yield (fn.rel, w.lineno, w.col,
+                       f"self.{attr} written on an event-loop path "
+                       f"({loop_fn}) and a thread path ({thread_fn}) "
+                       f"with no threading.Lock guard — serialize via a "
+                       f"lock, a queue, or call_soon_threadsafe")
+
+
+class LockAcrossAwaitRule(_ProjectRule):
+    rule_id = "RC003"
+    description = "threading lock held across an await"
+
+    def check_project(self, proj: ProjectContext):
+        for fn in sorted(proj.iter_functions(), key=lambda f: f.fid):
+            reported: Set[Tuple[str, ...]] = set()
+            for ha in fn.held_awaits:
+                if ha.lock in reported:
+                    continue
+                kind = proj.attr_type(fn, ha.lock)
+                if kind not in LOCK_KINDS:
+                    continue
+                reported.add(ha.lock)
+                lock_name = ".".join(ha.lock[1:]) or ha.lock[-1]
+                yield (fn.rel, ha.lineno, ha.col,
+                       f"threading lock {lock_name!r} held across await "
+                       f"in {fn.qualname}: loop latency leaks into every "
+                       f"other acquirer and re-entry deadlocks — release "
+                       f"before awaiting or use asyncio.Lock")
+
+
+class TaskLeakRule(_ProjectRule):
+    rule_id = "RC004"
+    description = ("fire-and-forget task/coroutine leak "
+                   "(handle dropped / never awaited)")
+
+    def check_project(self, proj: ProjectContext):
+        for fn in sorted(proj.iter_functions(), key=lambda f: f.fid):
+            for call in fn.calls:
+                if not call.is_stmt or call.awaited:
+                    continue
+                last = call.canon.rsplit(".", 1)[-1]
+                if last in _TASK_SPAWNERS:
+                    yield (fn.rel, call.lineno, call.col,
+                           f"{last}() result dropped in {fn.qualname}: "
+                           f"exceptions vanish and the task cannot be "
+                           f"cancelled — keep the handle and retrieve "
+                           f"its exception (or use the node's _spawn)")
+                    continue
+                tgt = proj.function(call.target)
+                if tgt is not None and tgt.is_async:
+                    yield (fn.rel, call.lineno, call.col,
+                           f"coroutine {tgt.qualname}() called as a bare "
+                           f"statement in {fn.qualname} is never awaited "
+                           f"— nothing runs; await it or schedule it as "
+                           f"a task")
+
+
+class LoopAffinityRule(_ProjectRule):
+    rule_id = "RC005"
+    description = "loop-affine asyncio API touched from a thread path"
+
+    def check_project(self, proj: ProjectContext):
+        for fn in sorted(proj.iter_functions(), key=lambda f: f.fid):
+            if THREAD not in fn.colors or LOOP in fn.colors:
+                continue
+            for call in fn.calls:
+                last = call.canon.rsplit(".", 1)[-1]
+                if last in _BOUNDARY_METHODS:
+                    continue
+                hint = LOOP_AFFINE_CALLS.get(call.canon)
+                if hint is None and last in _TASK_SPAWNERS and \
+                        "." in call.name:
+                    hint = "schedule via run_coroutine_threadsafe"
+                if hint is None:
+                    nparts = call.name.split(".")
+                    if nparts[0] == "self" and len(nparts) == 3:
+                        kind = proj.attr_type(fn, ("self", nparts[1]))
+                        if kind in LOOP_AFFINE_ATTR_KINDS:
+                            hint = ("asyncio primitives are not "
+                                    "thread-safe; marshal through "
+                                    "call_soon_threadsafe or a "
+                                    "queue.Queue")
+                if hint:
+                    yield (fn.rel, call.lineno, call.col,
+                           f"{call.canon}() touched from thread-side "
+                           f"{fn.qualname} — {hint}")
+
+
+RULES = [
+    TransitiveBlockingRule(),
+    CrossThreadWriteRule(),
+    LockAcrossAwaitRule(),
+    TaskLeakRule(),
+    LoopAffinityRule(),
+]

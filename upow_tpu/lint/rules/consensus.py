@@ -43,6 +43,7 @@ _WALL_CLOCK = {
 
 class _ConsensusRule:
     severity = SEVERITY_ERROR
+    requires_project = False    # per-file lexical rules (project API opt-out)
 
     def scope(self, parts: Tuple[str, ...]) -> bool:
         if parts[-1:] == ("clock.py",) and "core" in parts:

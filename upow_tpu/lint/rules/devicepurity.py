@@ -50,6 +50,7 @@ _JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
 
 class _DeviceRuleBase:
     severity = SEVERITY_ERROR
+    requires_project = False    # per-file lexical rules (project API opt-out)
 
     def scope(self, parts: Tuple[str, ...]) -> bool:
         # device/ IS the sanctioned dispatch layer; lint/ holds these

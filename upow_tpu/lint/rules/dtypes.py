@@ -54,6 +54,7 @@ def _dtype_of(node: ast.AST) -> Optional[str]:
 
 class _DtypeRule:
     severity = SEVERITY_ERROR
+    requires_project = False    # per-file lexical rules (project API opt-out)
 
     def scope(self, parts: Tuple[str, ...]) -> bool:
         return bool(_SCOPE.intersection(parts[:-1]))

@@ -43,6 +43,7 @@ def _in_allowlist(parts: Tuple[str, ...]) -> bool:
 
 class _EndiannessRule:
     severity = SEVERITY_ERROR
+    requires_project = False    # per-file lexical rules (project API opt-out)
 
     def scope(self, parts: Tuple[str, ...]) -> bool:
         return bool(_SCOPE.intersection(parts[:-1])) and not _in_allowlist(parts)

@@ -236,6 +236,8 @@ def _jit_findings(ctx: FileContext):
 
 
 class _JitRuleBase:
+    requires_project = False    # per-file lexical rules (project API opt-out)
+
     def scope(self, parts: Tuple[str, ...]) -> bool:
         return True  # jit purity is an invariant everywhere
 

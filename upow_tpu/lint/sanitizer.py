@@ -1,0 +1,360 @@
+"""Runtime concurrency sanitizer: the dynamic half of the RC family.
+
+The static rules prove what the call graph shows; this module catches
+what it cannot — a jit trace that compiles inline, a third-party call
+that blocks, a task whose exception dies un-retrieved in a branch the
+linter could not color.  It is an opt-in test/CI harness (installed by
+the tier-1/chaos conftest fixture, never by product code) with four
+mechanisms:
+
+* **Slow-callback watchdog** — every event-loop callback/task step is
+  timed by wrapping ``asyncio.events.Handle._run``; a sampler thread
+  additionally captures the live stack (``sys._current_frames()``) of
+  a callback still running past the threshold, so the finding names
+  the blocking frame, not just the coroutine.  Each trip records a
+  finding and emits a structured ``sanitizer.blocked_loop`` telemetry
+  event.
+* **Un-retrieved task exceptions** — asyncio reports these through
+  ``loop.call_exception_handler`` (often from ``Task.__del__`` long
+  after the fact); the class-level patch records them as findings so a
+  test that leaked one fails *now*.
+* **Never-awaited coroutines** — surfaced via a forced ``gc.collect()``
+  under ``warnings.catch_warnings`` at fixture teardown
+  (:meth:`ConcurrencySanitizer.flush_never_awaited`).
+* **Thread-affinity assertions** — the device runtime calls
+  :func:`check_blocking_wait` at its submit/drain seam
+  (``run_boxed``/``boxed_call``); if that seam is crossed from a
+  thread that is running an event loop, the sanitizer trips.
+
+Findings carry ``product`` attribution: a blocked-loop trip whose
+callback (or live stack) lands in ``upow_tpu/`` product code is a
+product bug; test code legitimately blocks its own loop (jax compiles,
+synchronous fixtures), so the conftest gate fails only on
+product-attributed trips.  Stdlib-only, like the rest of the linter.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import asyncio.base_events
+import asyncio.events
+import gc
+import sys
+import threading
+import time
+import traceback
+import warnings
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+_PRODUCT_MARKER = "upow_tpu"
+_SELF_MARKERS = ("upow_tpu/lint", "upow_tpu\\lint")
+
+
+@dataclass
+class SanitizerFinding:
+    kind: str                  # blocked_loop | task_exception |
+    #                            never_awaited | affinity
+    detail: str
+    product: bool              # attributed to product (non-lint) code
+    stack: str = ""
+    ts: float = field(default_factory=time.time)
+
+    def __str__(self) -> str:
+        tag = "product" if self.product else "test"
+        out = f"[{self.kind}/{tag}] {self.detail}"
+        if self.stack:
+            out += "\n" + self.stack
+        return out
+
+
+def _is_product_file(filename: str) -> bool:
+    if not filename:
+        return False
+    norm = filename.replace("\\", "/")
+    if any(m.replace("\\", "/") in norm for m in _SELF_MARKERS):
+        return False
+    return f"/{_PRODUCT_MARKER}/" in norm or \
+        norm.startswith(f"{_PRODUCT_MARKER}/")
+
+
+def _describe_handle(handle) -> Tuple[str, bool]:
+    """(human description, is-product) for a loop callback handle."""
+    cb = getattr(handle, "_callback", None)
+    task = getattr(cb, "__self__", None)
+    if isinstance(task, asyncio.Task):
+        try:
+            coro = task.get_coro()
+            code = getattr(coro, "cr_code", None)
+            if code is not None:
+                name = getattr(code, "co_qualname", code.co_name)
+                return (f"task {name} "
+                        f"({code.co_filename}:{code.co_firstlineno})",
+                        _is_product_file(code.co_filename))
+        # describing a finding must never crash the wrapped loop
+        # callback it runs inside of; fall back to repr
+        except Exception:  # upowlint: disable=BE001
+            pass
+        return (repr(task), False)
+    code = getattr(cb, "__code__", None)
+    if code is not None:
+        name = getattr(code, "co_qualname", code.co_name)
+        return (f"callback {name} "
+                f"({code.co_filename}:{code.co_firstlineno})",
+                _is_product_file(code.co_filename))
+    return (repr(cb), False)
+
+
+_CORO_FLAGS = 0x0080 | 0x0200   # CO_COROUTINE | CO_ASYNC_GENERATOR
+
+
+def _blame_coroutine(frame) -> Optional[bool]:
+    """Walk a live stack outward to the nearest *coroutine* frame and
+    return its product attribution (None when no coroutine frame is on
+    the stack).  The coroutine is the responsible party: a test
+    coroutine driving sync product code on its own loop is a test
+    choice, while a product coroutine stuck anywhere is a product bug."""
+    while frame is not None:
+        if frame.f_code.co_flags & _CORO_FLAGS:
+            return _is_product_file(frame.f_code.co_filename)
+        frame = frame.f_back
+    return None
+
+
+class ConcurrencySanitizer:
+    """Installable event-loop instrumentation; see module docstring.
+
+    One instance is installed at a time (module-level :func:`install` /
+    :func:`uninstall`); findings accumulate until :meth:`drain`.
+    """
+
+    def __init__(self, blocked_loop_threshold: float = 1.0):
+        self.threshold = float(blocked_loop_threshold)
+        self._findings: List[SanitizerFinding] = []
+        self._lock = threading.Lock()
+        # thread id -> (t0, handle) while a callback is mid-flight
+        self._running: Dict[int, Tuple[float, Any]] = {}
+        self._flagged: set = set()     # id(handle) already reported live
+        self._orig_run = None
+        self._orig_handler = None
+        self._watchdog: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.saw_loop_activity = False
+
+    # -- recording ---------------------------------------------------------
+
+    def _record(self, kind: str, detail: str, product: bool,
+                stack: str = "") -> None:
+        with self._lock:
+            self._findings.append(SanitizerFinding(
+                kind=kind, detail=detail, product=product, stack=stack))
+
+    def drain(self) -> List[SanitizerFinding]:
+        with self._lock:
+            out, self._findings = self._findings, []
+            self.saw_loop_activity = False
+            return out
+
+    # -- blocked-loop watchdog ---------------------------------------------
+
+    def _emit_blocked(self, detail: str, product: bool,
+                      stack: str, elapsed: float) -> None:
+        self._record("blocked_loop",
+                     f"{detail} blocked the event loop for "
+                     f"{elapsed:.3f}s (threshold {self.threshold:.3f}s)",
+                     product, stack)
+        try:
+            from .. import telemetry
+
+            telemetry.event("sanitizer.blocked_loop", callback=detail,
+                            seconds=round(elapsed, 3), product=product,
+                            stack=stack[-2000:])
+        # telemetry is best-effort: the finding itself is already
+        # recorded, and a telemetry failure must not mask it
+        except Exception:  # upowlint: disable=BE001
+            pass
+
+    def _wrapped_run(self, handle):
+        tid = threading.get_ident()
+        if tid in self._running:        # nested (re-entrant) — passthrough
+            return self._orig_run(handle)
+        self.saw_loop_activity = True
+        t0 = time.perf_counter()
+        self._running[tid] = (t0, handle)
+        try:
+            return self._orig_run(handle)
+        finally:
+            self._running.pop(tid, None)
+            elapsed = time.perf_counter() - t0
+            if elapsed >= self.threshold:
+                if id(handle) in self._flagged:
+                    self._flagged.discard(id(handle))
+                else:
+                    detail, product = _describe_handle(handle)
+                    self._emit_blocked(detail, product, "", elapsed)
+
+    def _watch(self) -> None:
+        interval = max(0.01, self.threshold / 4.0)
+        while not self._stop.wait(interval):
+            now = time.perf_counter()
+            for tid, (t0, handle) in list(self._running.items()):
+                if now - t0 < self.threshold or id(handle) in self._flagged:
+                    continue
+                self._flagged.add(id(handle))
+                frame = sys._current_frames().get(tid)
+                stack = "".join(traceback.format_stack(frame)) \
+                    if frame is not None else ""
+                detail, product = _describe_handle(handle)
+                # live stack beats callback attribution when it shows a
+                # coroutine frame — blame lands on the coroutine that is
+                # actually stuck, not on whoever scheduled the callback
+                if frame is not None:
+                    blame = _blame_coroutine(frame)
+                    if blame is not None:
+                        product = blame
+                self._emit_blocked(detail, product, stack, now - t0)
+
+    # -- un-retrieved task exceptions --------------------------------------
+
+    def _wrapped_exception_handler(self, loop, context):
+        message = context.get("message", "") or ""
+        if "never retrieved" in message:
+            src = context.get("task") or context.get("future")
+            exc = context.get("exception")
+            product = False
+            task = src if isinstance(src, asyncio.Task) else None
+            if task is not None:
+                code = getattr(task.get_coro(), "cr_code", None)
+                if code is not None:
+                    product = _is_product_file(code.co_filename)
+            self._record("task_exception",
+                         f"{message}: {src!r} -> {exc!r}", product)
+        return self._orig_handler(loop, context)
+
+    # -- never-awaited coroutines ------------------------------------------
+
+    def flush_never_awaited(self) -> None:
+        """Force 'coroutine ... was never awaited' warnings still held
+        in GC cycles out and record them as findings.  Coroutines whose
+        refcount hits zero during the test warn immediately instead —
+        the conftest fixture scans pytest's recorded warnings and feeds
+        those through :meth:`record_never_awaited`.
+
+        Only the young generations are collected: a cycle-held coroutine
+        abandoned moments ago is still young, and a full-heap collect
+        per test is measurably expensive once the suite has built up a
+        large object graph (jax keeps a lot alive)."""
+        if not self.saw_loop_activity:
+            return
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            gc.collect(1)
+        for w in caught:
+            self.record_never_awaited(str(w.message))
+
+    def record_never_awaited(self, message: str) -> None:
+        if "was never awaited" in message:
+            # the RuntimeWarning carries no filename for the coroutine
+            # itself; conservatively treat every leak as failing — a
+            # never-awaited coroutine is a bug wherever it lives
+            self._record("never_awaited", message, product=True)
+
+    # -- thread-affinity at the device-runtime seam ------------------------
+
+    def check_blocking_wait(self, site: str) -> None:
+        """Called by DeviceRuntime.run_boxed/boxed_call: blocking this
+        thread is only legal when no event loop runs on it.
+
+        Responsibility lies with the nearest enclosing *coroutine*
+        frame — the async code that chose to call a sync blocking API
+        on the loop — not with the sync product function itself (tests
+        legitimately drive sync entry points from their own loop)."""
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        frame = sys._getframe(1)
+        stack = "".join(traceback.format_stack(frame))
+        blame = _blame_coroutine(frame)
+        product = True if blame is None else blame
+        self._record(
+            "affinity",
+            f"{site} would block an event-loop thread (cross the seam "
+            f"with run_in_executor / await the runtime future instead)",
+            product=product, stack=stack)
+
+    # -- install/uninstall -------------------------------------------------
+
+    def install(self) -> None:
+        if self._orig_run is not None:
+            raise RuntimeError("sanitizer already installed")
+        self._orig_run = asyncio.events.Handle._run
+        sanitizer = self
+
+        def run(handle):
+            return sanitizer._wrapped_run(handle)
+
+        asyncio.events.Handle._run = run
+
+        self._orig_handler = \
+            asyncio.base_events.BaseEventLoop.call_exception_handler
+
+        def handler(loop, context):
+            return sanitizer._wrapped_exception_handler(loop, context)
+
+        asyncio.base_events.BaseEventLoop.call_exception_handler = handler
+
+        self._stop.clear()
+        self._watchdog = threading.Thread(
+            target=self._watch, name="upow-sanitizer-watchdog", daemon=True)
+        self._watchdog.start()
+
+    def uninstall(self) -> None:
+        if self._orig_run is None:
+            return
+        asyncio.events.Handle._run = self._orig_run
+        asyncio.base_events.BaseEventLoop.call_exception_handler = \
+            self._orig_handler
+        self._orig_run = None
+        self._orig_handler = None
+        self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2.0)
+            self._watchdog = None
+
+
+# --------------------------------------------------------------------------
+# Module-level singleton: product hooks must stay O(1) when inactive.
+# --------------------------------------------------------------------------
+
+_ACTIVE: Optional[ConcurrencySanitizer] = None
+
+
+def active() -> Optional[ConcurrencySanitizer]:
+    return _ACTIVE
+
+
+def install(blocked_loop_threshold: float = 1.0) -> ConcurrencySanitizer:
+    """Install a fresh sanitizer as the active one and return it."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError("sanitizer already installed")
+    san = ConcurrencySanitizer(blocked_loop_threshold=blocked_loop_threshold)
+    san.install()
+    _ACTIVE = san
+    return san
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.uninstall()
+        _ACTIVE = None
+
+
+def check_blocking_wait(site: str) -> None:
+    """Product-side hook (device runtime submit/drain seam): no-op
+    unless a sanitizer is installed."""
+    san = _ACTIVE
+    if san is not None:
+        san.check_blocking_wait(site)

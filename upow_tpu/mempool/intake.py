@@ -55,6 +55,19 @@ def _reject(error: str) -> dict:
     return {"ok": False, "error": error}
 
 
+def _log_drainer_exit(task: "asyncio.Task") -> None:
+    """Done-callback on the drainer task: a crash outside _process's
+    per-request catch (config access, queue bookkeeping) must be logged
+    now, not surface as 'exception was never retrieved' at GC time —
+    submitters whose futures it stranded respawn a fresh drainer on the
+    next submit, so the crash would otherwise be completely silent."""
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        log.error("intake drainer died: %r", exc)
+
+
 class _Req:
     __slots__ = ("tx", "sender", "fut", "t0", "tx_hash", "first_address",
                  "checks", "slice", "dup_of", "result", "span", "wait_span")
@@ -113,6 +126,7 @@ class IntakeCoordinator:
         if self._drainer is not None and not self._drainer.done():
             return
         self._drainer = asyncio.ensure_future(self._drain())
+        self._drainer.add_done_callback(_log_drainer_exit)
         bg = getattr(self.node, "_background", None)
         if bg is not None:
             bg.add(self._drainer)

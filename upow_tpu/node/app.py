@@ -946,18 +946,25 @@ class Node:
     async def h_debug_profile(self, request: web.Request) -> web.Response:
         """Opt-in jax.profiler capture control (ProfilingConfig):
         ``?action=start|stop|status``.  Route exists only when both
-        telemetry.debug_endpoints and profile.enabled say so."""
+        telemetry.debug_endpoints and profile.enabled say so.
+
+        The profiling calls run in an executor: a cold
+        ``jax.profiler.start_trace`` initializes the profiler plugin and
+        can block for seconds, which would stall every other request on
+        this loop (caught by the concurrency sanitizer)."""
         from .. import profiling
 
         pcfg = self.config.profile
         action = request.rel_url.query.get("action", "status")
+        loop = asyncio.get_running_loop()
         if action == "start":
-            result = profiling.start(pcfg.trace_dir,
-                                     pcfg.max_capture_seconds)
+            result = await loop.run_in_executor(
+                None, profiling.start, pcfg.trace_dir,
+                pcfg.max_capture_seconds)
         elif action == "stop":
-            result = profiling.stop()
+            result = await loop.run_in_executor(None, profiling.stop)
         elif action == "status":
-            result = profiling.status()
+            result = await loop.run_in_executor(None, profiling.status)
         else:
             return web.json_response(
                 {"ok": False,
@@ -1035,9 +1042,11 @@ class Node:
             return web.json_response(
                 {"ok": False, "error": "no such chunk"}, status=404)
         try:
-            with open(os.path.join(gen[0], snapshot_layout.chunk_name(i)),
-                      "rb") as fh:
-                data = fh.read()
+            # chunks are up to 16 MiB; a loop-thread read would stall
+            # every other handler while the disk seeks
+            chunk_file = os.path.join(gen[0], snapshot_layout.chunk_name(i))
+            data = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: open(chunk_file, "rb").read())
         except OSError:
             return web.json_response(
                 {"ok": False, "error": "no such chunk"}, status=404)

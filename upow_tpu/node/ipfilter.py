@@ -81,7 +81,10 @@ class IpFilter:
         if not self.path or not os.path.exists(self.path):
             return
         try:
-            with open(self.path) as f:
+            # RC001: tiny admin JSON, re-read at most once per
+            # reload_every seconds — not worth an executor hop in the
+            # middleware hot path
+            with open(self.path) as f:  # upowlint: disable=RC001
                 data = json.load(f)
             self.whitelist = set(data.get("whitelist", []))
             self.blocklist = set(data.get("blocklist", []))

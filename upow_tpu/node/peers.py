@@ -82,7 +82,10 @@ class PeerBook:
             return
         with self._lock:
             tmp = self.path + ".tmp"
-            with open(tmp, "w") as f:
+            # RC001: the peer book is a few KB; the synchronous
+            # write-then-rename under the lock is what keeps add/prune
+            # atomic against concurrent savers
+            with open(tmp, "w") as f:  # upowlint: disable=RC001
                 json.dump({"nodes": self._data}, f)
             os.replace(tmp, self.path)
 

@@ -28,10 +28,13 @@ the new one — never a torn mix — and the stale staging dir is swept by
 
 from __future__ import annotations
 
+import asyncio
+import functools
 import json
 import os
+import shutil
 import tempfile
-from typing import Optional
+from typing import List, Optional
 
 from .. import telemetry, trace
 from ..logger import get_logger
@@ -65,6 +68,23 @@ async def serialize_payload(state, blocks_tail: int) -> tuple:
     return b"".join(parts), counts
 
 
+def _write_generation(staging: str, chunks: List[bytes], manifest: dict,
+                      final: str) -> None:
+    """Durable half of a build (runs in an executor): fsync'd chunk
+    writes into staging, manifest, then the publishing rename."""
+    for i, chunk in enumerate(chunks):
+        with open(os.path.join(staging, layout.chunk_name(i)),
+                  "wb") as fh:
+            fh.write(chunk)
+            fh.flush()
+            os.fsync(fh.fileno())
+    layout.write_manifest(os.path.join(staging, layout.MANIFEST_NAME),
+                          manifest)
+    if os.path.isdir(final):  # same anchor rebuilt: replace wholesale
+        shutil.rmtree(final, ignore_errors=True)
+    os.replace(staging, final)
+
+
 async def build_snapshot(state, root: str, chunk_bytes: int = 1 << 20,
                          blocks_tail: int = 64,
                          keep: int = 2) -> Optional[dict]:
@@ -91,26 +111,18 @@ async def build_snapshot(state, root: str, chunk_bytes: int = 1 << 20,
         "counts": counts,
     }
     staging = tempfile.mkdtemp(prefix=".staging-", dir=root)
+    final = os.path.join(
+        root, layout.gen_name(anchor["id"], anchor["hash"]))
+    loop = asyncio.get_running_loop()
     try:
-        for i, chunk in enumerate(chunks):
-            with open(os.path.join(staging, layout.chunk_name(i)),
-                      "wb") as fh:
-                fh.write(chunk)
-                fh.flush()
-                os.fsync(fh.fileno())
-        layout.write_manifest(os.path.join(staging, layout.MANIFEST_NAME),
-                              manifest)
-        final = os.path.join(
-            root, layout.gen_name(anchor["id"], anchor["hash"]))
-        if os.path.isdir(final):  # same anchor rebuilt: replace wholesale
-            import shutil
-
-            shutil.rmtree(final, ignore_errors=True)
-        os.replace(staging, final)
+        # chunk writes + fsync barriers + the publishing rename are the
+        # slow durable half of a build; off the loop thread so a build
+        # under load cannot stall gossip/WS for seconds
+        await loop.run_in_executor(None, functools.partial(
+            _write_generation, staging, chunks, manifest, final))
     except BaseException:
-        import shutil
-
-        shutil.rmtree(staging, ignore_errors=True)
+        await loop.run_in_executor(None, functools.partial(
+            shutil.rmtree, staging, ignore_errors=True))
         raise
     layout.publish_current(root, os.path.basename(final))
     layout.prune_generations(root, keep=keep)

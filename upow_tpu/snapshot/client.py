@@ -34,6 +34,8 @@ full block replay — a bad snapshot peer must never break the join.
 
 from __future__ import annotations
 
+import asyncio
+import functools
 import hashlib
 import json
 import os
@@ -47,6 +49,17 @@ from . import layout
 from .builder import SNAPSHOT_TABLES
 
 log = get_logger("snapshot")
+
+
+async def _io(fn, *args):
+    """Run blocking journal/file work off the event loop.
+
+    A restore moves up to MAX_PAYLOAD_BYTES through open/fsync/replace
+    and one giant assemble+hash; doing that on the loop thread stalls
+    gossip, WS heartbeats, and every other handler for the duration.
+    """
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, functools.partial(fn, *args))
 
 
 class SnapshotError(Exception):
@@ -279,7 +292,8 @@ async def bootstrap_from_snapshot(state, sources, root: str,
                 manifest["payload_sha256"]:
             # new payload identity -> new journal; identical payload
             # from a failover source reuses every verified chunk
-            journal = _Journal(root, manifest)
+            # (construction prunes superseded journal dirs — executor)
+            journal = await _io(_Journal, root, manifest)
         chunks = journal.manifest["chunks"]
         # per-pass counters: on failover, "reused" counts the verified
         # chunks the new pass inherited (i.e. not re-downloaded)
@@ -291,7 +305,7 @@ async def bootstrap_from_snapshot(state, sources, root: str,
                         chunks=len(chunks))
         source_dead = False
         for i in range(len(chunks)):
-            if journal.have_verified(i):
+            if await _io(journal.have_verified, i):
                 progress["verified"] = progress.get("verified", 0) + 1
                 progress["reused"] = progress.get("reused", 0) + 1
                 trace.inc("snapshot.chunks_reused")
@@ -314,7 +328,7 @@ async def bootstrap_from_snapshot(state, sources, root: str,
                 # match alone would let the peer lie about sizes
                 if len(data) == chunks[i]["size"] and \
                         layout.sha256_hex(data) == chunks[i]["sha256"]:
-                    journal.commit_chunk(i, data)
+                    await _io(journal.commit_chunk, i, data)
                     ok = True
                     break
                 trace.inc("snapshot.chunk_integrity_failures")
@@ -339,8 +353,9 @@ async def _finish(state, journal, progress: dict, src: str,
     manifest = journal.manifest
     progress["phase"] = "verify"
     try:
-        payload = journal.assemble()
-        if layout.sha256_hex(payload) != manifest["payload_sha256"]:
+        payload = await _io(journal.assemble)
+        if await _io(layout.sha256_hex, payload) != \
+                manifest["payload_sha256"]:
             # each chunk verified individually, so this means the
             # manifest itself is inconsistent — poison, not transport
             raise SnapshotError("payload_hash_mismatch", src)
@@ -356,12 +371,12 @@ async def _finish(state, journal, progress: dict, src: str,
                 manifest["full_state_fingerprint"]:
             raise SnapshotError("fingerprint_mismatch", src)
     except SnapshotError:
-        journal.destroy()
+        await _io(journal.destroy)
         raise
     except Exception as e:
         # untrusted bytes must never raise past the SnapshotError
         # ladder — the caller's replay fallback catches only that
-        journal.destroy()
+        await _io(journal.destroy)
         raise SnapshotError("peer_malformed",
                             f"{src}: {type(e).__name__}: {e}")
     progress["phase"] = "restore"
@@ -376,14 +391,14 @@ async def _finish(state, journal, progress: dict, src: str,
     except Exception as e:
         # atomic() rolled back: the pre-restore state is intact and the
         # replay fallback can proceed on it
-        journal.destroy()
+        await _io(journal.destroy)
         raise SnapshotError("restore_failed",
                             f"{src}: {type(e).__name__}: {e}")
     if mismatch:
         # the unproven rows are already committed — wipe back to a
         # blank chain so the replay fallback syncs from genesis rather
         # than on top of state that failed its own cross-check
-        journal.destroy()
+        await _io(journal.destroy)
         try:
             await state.restore_snapshot(
                 {t: [] for t in SNAPSHOT_TABLES}, [], [])
@@ -391,7 +406,7 @@ async def _finish(state, journal, progress: dict, src: str,
             log.exception("could not reset state after restored-state"
                           " mismatch; replay fallback starts dirty")
         raise SnapshotError("restored_state_mismatch", src)
-    journal.destroy()
+    await _io(journal.destroy)
     progress["phase"] = "done"
     trace.inc("snapshot.restores")
     telemetry.event("snapshot_restore_complete", source=src,

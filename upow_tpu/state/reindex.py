@@ -111,7 +111,9 @@ async def amain(argv=None) -> int:
     finally:
         state.close()
         if tmpdir is not None:
-            shutil.rmtree(tmpdir, ignore_errors=True)
+            # RC001: offline maintenance CLI — nothing else shares
+            # this event loop while it tears down
+            shutil.rmtree(tmpdir, ignore_errors=True)  # upowlint: disable=RC001
 
 
 def main() -> int:

@@ -701,7 +701,10 @@ async def scenario_snapshot_churn(swarm: Swarm, seed: int):
         return core, observed
     finally:
         faultinject.uninstall()
-        shutil.rmtree(tmp, ignore_errors=True)
+        # scenario nodes are still serving on this loop; a blocking
+        # rmtree here would distort the very timings being measured
+        await asyncio.get_running_loop().run_in_executor(
+            None, lambda: shutil.rmtree(tmp, ignore_errors=True))
 
 
 # ------------------------------------------------------------- registry ----

@@ -42,9 +42,17 @@ from ..config import WsConfig
 from ..logger import get_logger
 
 log = get_logger("ws")
-from ..logger import get_logger
 
-log = get_logger("ws")
+
+def _retrieve(task: "asyncio.Task", what: str) -> None:
+    """Done-callback for hub background tasks: retrieve and log a crash
+    instead of leaving 'Task exception was never retrieved' to the GC
+    (which surfaces minutes later, far from the cause, or never)."""
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        log.error("ws %s task died: %r", what, exc)
 
 # broadcast encoder, module-level so tests can swap in a counting
 # wrapper: broadcast_to_channel serializes each message through this
@@ -254,7 +262,9 @@ class WsHub:
         self.by_ip.setdefault(conn.ip, set()).add(conn.id)
         self.connects_total += 1
         self._ensure_loops()
-        self._writers[conn.id] = asyncio.ensure_future(self._writer(conn))
+        writer = asyncio.ensure_future(self._writer(conn))
+        writer.add_done_callback(lambda t: _retrieve(t, "writer"))
+        self._writers[conn.id] = writer
 
     async def _writer(self, conn: WsConnection) -> None:
         """Drain one connection's send queue onto the wire.  A failed
@@ -340,8 +350,12 @@ class WsHub:
         if self._loops_started:
             return
         self._loops_started = True
-        self._loop_tasks.add(asyncio.ensure_future(self._cleanup_loop()))
-        self._loop_tasks.add(asyncio.ensure_future(self._stats_loop()))
+        for name, coro in (("cleanup", self._cleanup_loop()),
+                           ("stats", self._stats_loop())):
+            task = asyncio.ensure_future(coro)
+            task.add_done_callback(
+                lambda t, n=name: _retrieve(t, n))
+            self._loop_tasks.add(task)
 
     def close(self) -> None:
         """Drop every connection and cancel lifecycle/writer tasks
