@@ -1,7 +1,8 @@
 PYTHON ?= python
 
 .PHONY: lint lint-concurrency test ruff metrics-check perf-observatory \
-	perf-smoke swarm fleet device-runtime-smoke snapshot-smoke
+	perf-smoke swarm fleet device-runtime-smoke snapshot-smoke \
+	archive-smoke
 
 # Domain linter: consensus-endianness, consensus-purity, jit-purity,
 # dtype-hygiene, async-safety, broad-except, device-runtime purity.
@@ -80,6 +81,9 @@ fleet:
 # zeroes it on any failed core assertion, so the gate trips on broken
 # distribution semantics; the propagation quantiles are wall-clock
 # under load (widest bands) and report-only by substring.
+# archive_parity_ok (ISSUE 19) is ENFORCED identically: the pruned-vs-
+# twin scenario zeroes it when any archived read diverges from the
+# unpruned twin, so the gate trips on a broken hot/archive seam.
 perf-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.loadgen --smoke \
 		--out observatory-smoke.json \
@@ -88,6 +92,7 @@ perf-smoke:
 		--enforce kernel.accept_ \
 		--enforce kernel.mine_mesh \
 		--enforce kernel.fleet_core_ok \
+		--enforce kernel.archive_parity_ok \
 		--metric-tolerance kernel.verify_pipeline=0.60 \
 		--metric-tolerance kernel.verify_pipeline_serial=0.60 \
 		--metric-tolerance kernel.verify_pipeline_speedup=0.60 \
@@ -109,6 +114,15 @@ perf-smoke:
 # run twice so the core fingerprint must reproduce byte-identically.
 snapshot-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.snapshot --check-determinism
+
+# Archive tier gate (docs/ARCHIVE.md): a multi-thousand-block
+# pruned-vs-twin deep-read differential, a kill -9 between
+# archive-commit and hot-delete that must resume losslessly, and the
+# archive_prune scenario (HTTP parity incl. a reorg inside the safety
+# window, peer mirror) run twice so the core fingerprint must
+# reproduce byte-identically.
+archive-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.archive --check-determinism
 
 # Device-runtime gate (docs/DEVICE_RUNTIME.md): the fairness /
 # coalescing / degrade-flip / arm-failure test matrix, then the DR

@@ -268,6 +268,43 @@ class SnapshotConfig:
     max_payload_bytes: int = 1 << 30  # oversize manifest is rejected
                                     # before any chunk is fetched
                                     # (anti-DoS on the bootstrap path)
+    rebuild_interval_blocks: int = 0  # rebuild the snapshot generation
+                                    # every N accepted blocks (0 =
+                                    # operator-driven only); arms the
+                                    # archive compactor without an
+                                    # operator
+    rebuild_jitter_blocks: int = 0  # per-node deterministic offset
+                                    # (seeded from the node identity,
+                                    # 0..jitter) added to the cadence so
+                                    # a fleet doesn't rebuild in
+                                    # lockstep
+
+
+@dataclass
+class ArchiveConfig:
+    """Cold-block archival tier (upow_tpu/archive/, docs/ARCHIVE.md).
+    Operational only: pruned and unpruned nodes answer every read
+    byte-identically, so none of these knobs touch consensus.  All
+    overridable as ``UPOW_ARCHIVE_<FIELD>``."""
+
+    dir: str = ""                   # archive root directory; '' disables
+                                    # the whole tier (no reader attach,
+                                    # no compactor, /archive/* serve 404)
+    segment_blocks: int = 256       # fixed height range per segment;
+                                    # a pure function of chain content,
+                                    # so every node on the same chain
+                                    # with the same setting produces
+                                    # byte-identical segments
+    safety_window: int = 64         # blocks below the snapshot anchor
+                                    # kept hot regardless (must exceed
+                                    # any plausible reorg depth; pair
+                                    # with node.sync_reorg_window)
+    reader_cache_segments: int = 4  # parsed segments kept in memory for
+                                    # fallthrough reads (LRU)
+    max_segment_bytes: int = 256 << 20  # fetch-side ceiling on what a
+    max_segments: int = 1 << 12         # peer manifest may declare
+                                        # (anti-DoS, mirrors snapshot
+                                        # restore caps)
 
 
 @dataclass
@@ -392,6 +429,7 @@ class Config:
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     cache: CacheConfig = field(default_factory=CacheConfig)
     snapshot: SnapshotConfig = field(default_factory=SnapshotConfig)
+    archive: ArchiveConfig = field(default_factory=ArchiveConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     profile: ProfilingConfig = field(default_factory=ProfilingConfig)
 
@@ -437,7 +475,7 @@ def _merge_dict(cfg: Config, data: dict) -> Config:
 def _merge_env(cfg: Config) -> Config:
     for section in ("device", "device_runtime", "node", "ws", "miner",
                     "log", "resilience", "mempool", "cache", "snapshot",
-                    "telemetry", "profile"):
+                    "archive", "telemetry", "profile"):
         _apply_env_fields(getattr(cfg, section), section)
     return cfg
 

@@ -22,6 +22,12 @@ structured JSON artifact:
   span, and ``fleet_core_ok`` mirrored into ``kernels`` with the
   propagation quantiles (zeroed on any core assertion failure so the
   enforced gate trips on broken distribution semantics).
+* ``archive`` — the cold-block archival differential
+  (:mod:`..archive.parity`): the archive_prune scenario's pruned node
+  vs unpruned twin byte parity, with ``archive_parity_ok`` mirrored
+  into ``kernels`` (zeroed on any divergence so the enforced gate
+  trips on a broken hot/archive seam, same idiom as
+  ``fleet_core_ok``).
 * ``provenance`` — what actually ran: ``backend``, ``platform``,
   ``attempted_backend``, ``arm_failure_reason``, ``arm_attempt``
   (which arm attempt produced this process — ``runtime`` /
@@ -289,6 +295,19 @@ def run_observatory(spec: Optional[PopulationSpec] = None,
         # the differential-zeroed kernel headlines above)
         kernels.update(fleet["kernels"])
 
+    archive = None
+    try:
+        from ..archive.parity import observatory_section \
+            as archive_section
+
+        archive = archive_section()
+    except Exception as e:
+        log.warning("archive parity differential skipped: %s", e)
+    if archive is not None:
+        # archive_parity_ok zeroes on ANY failed core assertion in the
+        # pruned-vs-twin scenario, defeating any gate tolerance
+        kernels.update(archive["kernels"])
+
     if cost:
         try:
             analysis = _kernel_cost_analysis()
@@ -335,6 +354,9 @@ def run_observatory(spec: Optional[PopulationSpec] = None,
         # per-node fleet latency rows + propagation quantile rows ride
         # the endpoint table (names are fleet.-prefixed: no collisions)
         artifact["slo"]["endpoints"].update(fleet["slo_endpoints"])
+    if archive is not None:
+        artifact["archive"] = archive["section"]
+        artifact["slo"]["endpoints"].update(archive["slo_endpoints"])
     return artifact
 
 

@@ -236,6 +236,42 @@ class Node:
 
             snapshot_layout.prune_generations(self.config.snapshot.dir,
                                               keep=self.config.snapshot.keep)
+        # cold-block archival tier (upow_tpu/archive/, docs/ARCHIVE.md):
+        # attach the read-fallthrough seam to the storage backend.
+        # Archived rows are immutable, so the hotcache generation is
+        # untouched — cached responses are byte-identical either way.
+        self.archive_compact: dict = {}
+        if self.config.archive.dir:
+            from ..archive import ArchiveReader
+
+            self.state.archive = ArchiveReader(
+                self.config.archive.dir,
+                cache_segments=self.config.archive.reader_cache_segments)
+        # background snapshot rebuild cadence (SnapshotConfig.
+        # rebuild_interval_blocks): every committed block ticks a
+        # counter; at interval + per-node jitter a rebuild (and the
+        # archive compaction it arms) is spawned off the hook.  The
+        # jitter is a deterministic hash of the node's identity so a
+        # fleet started together doesn't rebuild in lockstep.
+        self._snapshot_rebuild_inflight = False
+        self._blocks_since_rebuild = 0
+        scfg = self.config.snapshot
+        if scfg.dir and scfg.rebuild_interval_blocks > 0:
+            ident = (self.config.node.self_url
+                     or f"{self.config.node.host}:{self.config.node.port}")
+            jitter = max(0, scfg.rebuild_jitter_blocks)
+            self._rebuild_target = scfg.rebuild_interval_blocks + (
+                int.from_bytes(
+                    hashlib.sha256(ident.encode()).digest()[:4], "big")
+                % (jitter + 1))
+            base_committed = self.manager.on_state_committed
+
+            def _committed(_base=base_committed):
+                if _base is not None:
+                    _base()
+                self._snapshot_rebuild_tick()
+
+            self.manager.on_state_committed = _committed
         self.app = self._build_app()
 
     # ----------------------------------------------------------- plumbing --
@@ -732,6 +768,23 @@ class Node:
                     sr.get("reused", 0),
                     "Verified chunks reused from the journal (not"
                     " re-downloaded) by the current restore pass")
+        # archive families are emitted unconditionally (zeros when the
+        # tier is disabled) so make metrics-check can pin their names
+        ast = self.state.archive.stats() if self.state.archive else {}
+        e.gauge("archive_segments", ast.get("segments", 0),
+                "Published cold-archive segments")
+        e.gauge("archive_archived_blocks", ast.get("archived_blocks", 0),
+                "Blocks held by the published archive manifest")
+        e.gauge("archive_archived_txs", ast.get("archived_txs", 0),
+                "Transactions held by the published archive manifest")
+        e.counter("archive_hot_rows_pruned",
+                  (self.archive_compact.get("pruned_blocks", 0)
+                   + self.archive_compact.get("pruned_txs", 0))
+                  if self.archive_compact.get("ok") else 0,
+                  "Hot block+tx rows deleted by the last compaction")
+        e.counter("archive_fallthrough_reads",
+                  ast.get("fallthrough_reads", 0),
+                  "Reads served from archive segments after a hot miss")
         sig = sig_verdict_stats()
         e.gauge("sig_cache_entries", sig["size"],
                 "Entries in the signature-verdict cache")
@@ -1123,6 +1176,130 @@ class Node:
         sync = await self.sync_blockchain()
         return {"ok": bool(sync.get("ok")), "method": "replay_fallback",
                 "reason": reason, "sync": sync}
+
+    # --------------------------------------------------------- archive ---
+    # Disk-only serving, mirroring /snapshot/*: authoritative bytes
+    # come straight from the published manifest + segment files (NOT
+    # routed through _cached — peers verifying content hashes need the
+    # store's truth, and tests pin the no-cache-bypass property).
+
+    async def _archive_manifest(self) -> Optional[dict]:
+        reader = self.state.archive
+        if not self.config.archive.dir or reader is None:
+            return None
+        return await asyncio.get_running_loop().run_in_executor(
+            None, reader.store.current_manifest)
+
+    async def h_archive_manifest(self,
+                                 request: web.Request) -> web.Response:
+        manifest = await self._archive_manifest()
+        if manifest is None:
+            return web.json_response(
+                {"ok": False, "error": "no archive available"},
+                status=404)
+        trace.inc("archive.manifest_served")
+        return web.json_response({"ok": True, "result": manifest})
+
+    async def h_archive_segment(self, request: web.Request) -> web.Response:
+        try:
+            i = int(request.match_info["i"])
+        except (KeyError, ValueError):
+            return web.json_response(
+                {"ok": False, "error": "segment index must be an integer"},
+                status=422)
+        manifest = await self._archive_manifest()
+        if manifest is None or not 0 <= i < len(manifest["segments"]):
+            return web.json_response(
+                {"ok": False, "error": "no such segment"}, status=404)
+        record = manifest["segments"][i]
+        try:
+            # segments can be tens of MB; a loop-thread read would
+            # stall every other handler while the disk seeks
+            data = await asyncio.get_running_loop().run_in_executor(
+                None, self.state.archive.store.read_payload,
+                record["name"])
+        except OSError:
+            return web.json_response(
+                {"ok": False, "error": "no such segment"}, status=404)
+        trace.inc("archive.segments_served")
+        return web.json_response(
+            {"ok": True, "result": {"i": i, "name": record["name"],
+                                    "data": data.hex()}})
+
+    async def h_debug_archive(self, request: web.Request) -> web.Response:
+        reader = self.state.archive
+        if reader is None:
+            return web.json_response(
+                {"ok": False, "error": "archive disabled"}, status=404)
+        await reader.coverage()  # stats() reads the cached manifest
+        return web.json_response({"ok": True, "result": {
+            "reader": reader.stats(),
+            "last_compaction": self.archive_compact,
+            "hot_rows": await self.state.archive_hot_row_counts(),
+        }}, dumps=_json_dumps)
+
+    async def compact_archive(self) -> dict:
+        """One compaction cycle against the newest published snapshot
+        generation (archive/compactor.py; crash-safe, idempotent)."""
+        acfg = self.config.archive
+        if not acfg.dir or not self.config.snapshot.dir:
+            return {"ok": False, "reason": "archive_disabled"}
+        from ..archive import compactor
+
+        stats = await compactor.compact(self.state, acfg.dir,
+                                        self.config.snapshot.dir, acfg,
+                                        reader=self.state.archive)
+        self.archive_compact = stats
+        return stats
+
+    async def fetch_archive_from_peer(self, source: str) -> dict:
+        """Mirror a peer's archive (deep-history sync/replay feed)."""
+        acfg = self.config.archive
+        if not acfg.dir:
+            return {"ok": False, "reason": "archive_disabled"}
+        from ..archive.reader import ArchiveFetchError, fetch_archive
+
+        iface = self.iface_factory(source, self.config.node,
+                                   session=self._session(),
+                                   resilience=self.resilience)
+        try:
+            result = await fetch_archive(
+                iface, acfg.dir,
+                max_segment_bytes=acfg.max_segment_bytes,
+                max_segments=acfg.max_segments)
+        except (ArchiveFetchError, ConnectionError, asyncio.TimeoutError,
+                OSError) as e:
+            return {"ok": False, "reason": str(e)}
+        finally:
+            await iface.close()
+        if self.state.archive is not None:
+            self.state.archive.invalidate()
+        return result
+
+    def _snapshot_rebuild_tick(self) -> None:
+        """Committed-block hook: arm a background snapshot rebuild (and
+        the archive compaction it enables) every rebuild_interval_blocks
+        + jitter blocks."""
+        self._blocks_since_rebuild += 1
+        if (self._blocks_since_rebuild >= self._rebuild_target
+                and not self._snapshot_rebuild_inflight):
+            self._blocks_since_rebuild = 0
+            self._snapshot_rebuild_inflight = True
+            self._spawn(self._snapshot_rebuild())
+
+    async def _snapshot_rebuild(self) -> None:
+        try:
+            manifest = await self.build_snapshot()
+            if manifest is not None:
+                trace.inc("snapshot.auto_rebuilds")
+            if self.config.archive.dir:
+                await self.compact_archive()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.warning("background snapshot rebuild failed: %s", e)
+        finally:
+            self._snapshot_rebuild_inflight = False
 
     async def h_push_tx(self, request: web.Request) -> web.Response:
         if self.is_syncing:
@@ -2081,15 +2258,18 @@ class Node:
             ("/dobby_info", self.h_dobby_info),
             ("/get_supply_info", self.h_get_supply_info),
             ("/snapshot/manifest", self.h_snapshot_manifest),
+            ("/archive/manifest", self.h_archive_manifest),
             ("/metrics", self.h_metrics),
         ]:
             r.add_get(path, handler)
         r.add_get("/snapshot/chunk/{i}", self.h_snapshot_chunk)
+        r.add_get("/archive/segment/{i}", self.h_archive_segment)
         if self.config.telemetry.debug_endpoints:
             r.add_get("/debug/traces", self.h_debug_traces)
             r.add_get("/debug/events", self.h_debug_events)
             r.add_get("/debug/breakers", self.h_debug_breakers)
             r.add_get("/debug/cache", self.h_debug_cache)
+            r.add_get("/debug/archive", self.h_debug_archive)
             if self.config.profile.enabled:
                 r.add_get("/debug/profile", self.h_debug_profile)
         if self.config.ws.enabled:

@@ -33,6 +33,11 @@ DEFAULT_LIMITS = {
     # back, a manifest poll is one small JSON read
     "/snapshot/manifest": "30/minute",
     "/snapshot/chunk": "20/second",
+    # archive serving (docs/ARCHIVE.md): same fairness stance — every
+    # /archive/segment/{i} collapses into ONE "/archive/segment"
+    # bucket, so per-index windows cannot multiply the budget
+    "/archive/manifest": "30/minute",
+    "/archive/segment": "10/second",
 }
 
 _PERIODS = {"second": 1.0, "minute": 60.0, "hour": 3600.0}

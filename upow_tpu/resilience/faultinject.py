@@ -22,9 +22,16 @@ fires once per simulated transfer with key ``"src->dst"``, so ``key=``
 can target one direction of one link), ``snapshot.serve`` (node/app.py
 — per /snapshot/manifest and /snapshot/chunk response, key
 ``"manifest"`` or ``"chunk/<i>"``; the ``corrupt`` kind flips served
-chunk bytes instead of erroring) and ``snapshot.fetch``
+chunk bytes instead of erroring), ``snapshot.fetch``
 (snapshot/client.py, per bootstrap RPC attempt inside the retry
-policy, key ``"<source url>#manifest"`` or ``"<source url>#chunk/<i>"``).
+policy, key ``"<source url>#manifest"`` or ``"<source url>#chunk/<i>"``),
+``archive.compact`` (archive/compactor.py — fires at each phase of a
+compaction cycle with key ``"closure"``, ``"segment/<lo>"``,
+``"publish"`` or ``"prune"``; an ``error`` kind between publish and
+prune simulates a kill -9 between archive-commit and hot-delete) and
+``archive.fetch`` (archive/reader.py fetch_archive, key ``"manifest"``
+or ``"segment/<i>"``; ``corrupt`` rewrites fetched payload bytes so
+integrity rejection paths can be exercised).
 
 Sites are prefix-matched (``rpc`` matches ``rpc.get_blocks``); ``key``
 substring-filters the per-call key (usually the peer URL).  ``kind`` is

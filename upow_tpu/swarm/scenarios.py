@@ -707,6 +707,190 @@ async def scenario_snapshot_churn(swarm: Swarm, seed: int):
             None, lambda: shutil.rmtree(tmp, ignore_errors=True))
 
 
+def _archive_prune_cfg(i: int, cfg) -> None:
+    """Tiny segments and a short safety window so a swarm-length chain
+    spans several archive segments and the compactor actually prunes."""
+    cfg.node.sync_page = 1
+    cfg.archive.segment_blocks = 4
+    cfg.archive.safety_window = 4
+
+
+async def scenario_archive_prune(swarm: Swarm, seed: int):
+    """Cold-block archival tier (docs/ARCHIVE.md): node 0 mines, builds
+    a snapshot, and compacts its hot store into the content-addressed
+    archive while node 1 keeps the full hot chain as an unpruned twin.
+    Every read the archive now backs — get_block, get_blocks_details
+    pages spanning the hot/archive seam, get_transaction, address
+    history — must answer byte-identically on both nodes (canonical
+    JSON fingerprints), before AND after a reorg inside the safety
+    window.  Node 2 then mirrors the archive over /archive/* and the
+    twin independently compacts its own copy to prove segments are a
+    pure function of chain content."""
+    assert swarm.n >= 3, "archive_prune needs 3 nodes"
+    from ..archive import ArchiveReader
+    from ..wallet.builders import WalletBuilder
+
+    urls = swarm.urls
+    d, addr = _wallet(seed, "shared")
+    _, addr_sink = _wallet(seed, "archive_sink")
+    tmp = tempfile.mkdtemp(prefix="archive-prune-")
+    try:
+        # node 0: pruned node; node 1: unpruned twin; node 2: mirror
+        n0, n1, n2 = swarm.nodes[0], swarm.nodes[1], swarm.nodes[2]
+        n0.config.snapshot.dir = os.path.join(tmp, "snap0")
+        n0.config.snapshot.blocks_tail = 4
+        for node, name in ((n0, "archive0"), (n2, "archive2")):
+            acfg = node.config.archive
+            acfg.dir = os.path.join(tmp, name)
+            node.state.archive = ArchiveReader(
+                acfg.dir, cache_segments=acfg.reader_cache_segments)
+
+        for _ in range(20):
+            assert (await swarm.mine(0, addr, push_to=[0, 1]))["ok"]
+        # spend every early coinbase into a sink: those txs leave the
+        # UTXO set, so their blocks fall out of the witness closure and
+        # become prunable — a pure-coinbase chain keeps every block hot
+        from ..core.constants import SMALLEST
+        outputs = await n0.state.get_spendable_outputs(addr)
+        balance = Decimal(sum(o.amount for o in outputs)) / SMALLEST
+        tx = await WalletBuilder(n0.state).create_transaction(
+            d, addr_sink, balance)
+        for i in (0, 1):   # push_block ships tx HASHES; both mempools
+            res = await swarm.get(i, "push_tx", {"tx_hex": tx.hex()})
+            assert res.get("ok"), res
+        for _ in range(8):
+            assert (await swarm.mine(0, addr, push_to=[0, 1]))["ok"]
+
+        hot_before = await n0.state.archive_hot_row_counts()
+        assert (await n0.build_snapshot()) is not None
+        with n0.telemetry_scope.activate():
+            stats = await n0.compact_archive()
+        hot_after = await n0.state.archive_hot_row_counts()
+        through = stats.get("archived_through", 0)
+
+        # the parity probe set: every archived block by height, pages
+        # that straddle the hot/archive seam, every archived tx, and
+        # the miner's full address history
+        tx_hashes = []
+        for h in range(1, through + 1):
+            blk = await n1.state.get_block_by_id(h)
+            tx_hashes.extend(
+                await n1.state.get_block_transaction_hashes(blk["hash"]))
+        probes = [("get_block", {"block": str(h),
+                                 "full_transactions": "true"})
+                  for h in range(1, through + 1)]
+        probes += [("get_blocks_details",
+                    {"offset": str(off), "limit": "8"})
+                   for off in range(1, 28, 8)]
+        probes += [("get_transaction", {"tx_hash": h}) for h in tx_hashes]
+        probes += [("get_address_transactions",
+                    {"address": addr, "page": str(p), "limit": "15"})
+                   for p in (1, 2)]
+
+        async def parity() -> bool:
+            for path, params in probes:
+                a = await swarm.get(0, path, params)
+                b = await swarm.get(1, path, params)
+                if not a.get("ok") or \
+                        artifact_fingerprint(a) != artifact_fingerprint(b):
+                    log.error("archive parity diverged on %s %s", path,
+                              params)
+                    return False
+            return True
+
+        parity_before_reorg = await parity()
+
+        # reorg INSIDE the safety window: node 0 mines a private block,
+        # the twin mines two, node 0 syncs over and must drop its own —
+        # every row touched is above archived_through, so the archive
+        # stays valid and parity must hold afterwards
+        pre_reorg = (await swarm.tips())[0]
+        assert (await swarm.mine(0, addr, push_to=[0]))["ok"]
+        for _ in range(2):
+            assert (await swarm.mine(1, addr, push_to=[1]))["ok"]
+        res_sync = await _sync_from(swarm, 0, winner=1)
+        tips = await swarm.tips()
+        reorged = bool(res_sync.get("ok")) and \
+            tips[0]["hash"] == tips[1]["hash"] and \
+            tips[0]["hash"] != pre_reorg["hash"]
+        parity_after_reorg = await parity()
+
+        # a second cycle against the same snapshot generation must be a
+        # no-op: nothing new to build, closure predicate matches nothing
+        stats2 = await n0.compact_archive()
+
+        # node 2 (blank hot store) mirrors the archive over /archive/*
+        fetch = await n2.fetch_archive_from_peer(urls[0])
+        cov2 = await n2.state.archive.coverage()
+
+        # the twin compacts its OWN copy: overlapping segments must be
+        # byte-identical (content-addressing is a pure function of
+        # chain content).  Runs after every parity probe — it prunes.
+        n1.config.snapshot.dir = os.path.join(tmp, "snap1")
+        n1.config.snapshot.blocks_tail = 4
+        n1.config.archive.dir = os.path.join(tmp, "archive1")
+        n1.state.archive = ArchiveReader(
+            n1.config.archive.dir,
+            cache_segments=n1.config.archive.reader_cache_segments)
+        assert (await n1.build_snapshot()) is not None
+        stats_twin = await n1.compact_archive()
+        m0 = await n0._archive_manifest()
+        m1 = await n1._archive_manifest()
+        shared = min(len(m0["segments"]), len(m1["segments"]))
+        twin_segments_identical = shared > 0 and all(
+            m0["segments"][k]["payload_sha256"]
+            == m1["segments"][k]["payload_sha256"]
+            and m0["segments"][k]["index_sha256"]
+            == m1["segments"][k]["index_sha256"]
+            for k in range(shared))
+
+        compact_events = fleet_scrape.merged_events(
+            swarm, kind="archive_compact_complete")
+        core = {
+            "compaction_ok": bool(stats.get("ok")),
+            "archived_through": through,
+            "segments_published": stats.get("segments", 0),
+            "hot_blocks_before": hot_before["blocks"],
+            "hot_blocks_after": hot_after["blocks"],
+            "hot_txs_before": hot_before["txs"],
+            "hot_txs_after": hot_after["txs"],
+            "hot_rows_reduced":
+                hot_after["blocks"] < hot_before["blocks"]
+                and hot_after["txs"] < hot_before["txs"],
+            "parity_before_reorg": parity_before_reorg,
+            "reorg_inside_safety_window": reorged,
+            "parity_after_reorg": parity_after_reorg,
+            "recompaction_noop": bool(stats2.get("ok"))
+                and stats2.get("segments_built") == 0
+                and stats2.get("pruned_blocks") == 0,
+            "mirror_fetch_ok": bool(fetch.get("ok"))
+                and fetch.get("fetched", 0) > 0,
+            "mirror_coverage_exact": cov2 == (1, through),
+            "twin_segments_identical": twin_segments_identical,
+            "fallthrough_reads_counted":
+                n0.state.archive.fallthrough_reads > 0,
+            "compact_event_emitted": len(compact_events) >= 1,
+            "final_height": tips[1]["id"],
+            "final_tip": tips[1]["hash"],
+        }
+        observed = {
+            "compaction": stats,
+            "recompaction": stats2,
+            "twin_compaction": {k: stats_twin.get(k)
+                                for k in ("ok", "archived_through",
+                                          "segments_built")},
+            "mirror_fetch": fetch,
+            "reader_stats": n0.state.archive.stats(),
+            "probes": len(probes),
+            "sync_result": {k: res_sync.get(k) for k in ("ok", "error")},
+        }
+        return core, observed
+    finally:
+        faultinject.uninstall()
+        await asyncio.get_running_loop().run_in_executor(
+            None, lambda: shutil.rmtree(tmp, ignore_errors=True))
+
+
 # ------------------------------------------------------------- registry ----
 
 @dataclass(frozen=True)
@@ -742,6 +926,11 @@ SCENARIOS: Dict[str, ScenarioSpec] = {
         topology="isolated",
         swarm_kwargs={"reorg_window": 4,
                       "cfg_hook": _snapshot_churn_cfg}),
+    "archive_prune": ScenarioSpec(
+        scenario_archive_prune, nodes=3, fast=True,
+        topology="isolated",
+        swarm_kwargs={"reorg_window": 4,
+                      "cfg_hook": _archive_prune_cfg}),
 }
 
 # The geo soak lives in the fleet package (fleet/geosoak.py: continent

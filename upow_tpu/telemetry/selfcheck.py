@@ -29,6 +29,13 @@ REQUIRED = (
     "upow_kernel_p256_verify_compile_cache_misses_total",
     "upow_block_height",
     "upow_mempool_transactions",
+    # archive tier families (docs/ARCHIVE.md) — emitted as zeros even
+    # when ArchiveConfig.dir is unset, so a bare node still carries them
+    "upow_archive_segments",
+    "upow_archive_archived_blocks",
+    "upow_archive_archived_txs",
+    "upow_archive_hot_rows_pruned",
+    "upow_archive_fallthrough_reads",
 )
 
 #: families the merged fleet rendering must always carry
