@@ -2,7 +2,7 @@ PYTHON ?= python
 
 .PHONY: lint lint-concurrency test ruff metrics-check perf-observatory \
 	perf-smoke swarm fleet device-runtime-smoke snapshot-smoke \
-	archive-smoke
+	archive-smoke alert-smoke
 
 # Domain linter: consensus-endianness, consensus-purity, jit-purity,
 # dtype-hygiene, async-safety, broad-except, device-runtime purity.
@@ -84,6 +84,10 @@ fleet:
 # archive_parity_ok (ISSUE 19) is ENFORCED identically: the pruned-vs-
 # twin scenario zeroes it when any archived read diverges from the
 # unpruned twin, so the gate trips on a broken hot/archive seam.
+# watchtower_clean_ok (ISSUE 20) is ENFORCED the same way: the geo-soak
+# runs with the default alert rule pack armed on every node and zeroes
+# the kernel if any alert fires on the clean run (or the engine never
+# ticked), so a rule pack that pages on healthy churn fails the gate.
 perf-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.loadgen --smoke \
 		--out observatory-smoke.json \
@@ -93,6 +97,7 @@ perf-smoke:
 		--enforce kernel.mine_mesh \
 		--enforce kernel.fleet_core_ok \
 		--enforce kernel.archive_parity_ok \
+		--enforce kernel.watchtower_clean_ok \
 		--metric-tolerance kernel.verify_pipeline=0.60 \
 		--metric-tolerance kernel.verify_pipeline_serial=0.60 \
 		--metric-tolerance kernel.verify_pipeline_speedup=0.60 \
@@ -123,6 +128,15 @@ snapshot-smoke:
 # reproduce byte-identically.
 archive-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.archive --check-determinism
+
+# Alerting gate (docs/ALERTING.md): jax-free detector and burn-rate
+# golden units, the alert state machine, then the watchtower_storm
+# scenario — injected gossip faults must page breaker_flip_storm with
+# a cross-node exemplar and the flight recorder must dump with the
+# alert as the trigger — run twice so the core fingerprint must
+# reproduce byte-identically.
+alert-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m upow_tpu.watchtower --check-determinism
 
 # Device-runtime gate (docs/DEVICE_RUNTIME.md): the fairness /
 # coalescing / degrade-flip / arm-failure test matrix, then the DR

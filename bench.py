@@ -402,6 +402,12 @@ def main() -> int:
                          "must not mark a queue step done on a CPU number)")
     args = ap.parse_args()
 
+    # Any node built inside a bench-driven process inherits this:
+    # watchtower alert_fired records land in the same rotated
+    # .bench_events.jsonl, so paging incidents and arm failures
+    # interleave on one timeline (tpu_watch surfaces both).
+    os.environ.setdefault("UPOW_WATCHTOWER_BENCH_EVENTS", _BENCH_EVENTS)
+
     import jax
 
     from upow_tpu import compile_cache

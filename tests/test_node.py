@@ -1552,7 +1552,9 @@ def test_metrics_endpoint(tmp_path, keys):
         for line in body.splitlines():
             if line and not line.startswith("#"):
                 name, _, value = line.partition(" ")
-                metrics[name] = float(value)
+                # bucket lines may carry an OpenMetrics exemplar suffix:
+                # "<value> # {trace_id=...} <exemplar_value>"
+                metrics[name] = float(value.partition(" # ")[0])
         assert metrics["upow_block_height"] == 1
         assert metrics["upow_mempool_transactions"] == 1
         assert metrics["upow_node_syncing"] == 0

@@ -1,0 +1,527 @@
+"""Watchtower tests (ISSUE 20): streaming detectors, SLO burn-rate
+evaluation, the alert state machine, the engine over scoped telemetry
+registries, the /debug surfaces, and the two adversarial scenario legs
+— the storm that must page and the clean geo-soak that must not.
+
+Unit layers run jax-free on synthetic series so a failure names the
+exact detector/threshold; the acceptance legs call
+:func:`run_scenario` — the same entry ``make alert-smoke`` and CI use.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from test_node import (Cluster, easy_difficulty, keys, make_config,  # noqa: F401
+                       run_cluster)
+from upow_tpu import telemetry
+from upow_tpu.config import WatchtowerConfig
+from upow_tpu.fleet import recorder
+from upow_tpu.fleet.geosoak import fleet_rows
+from upow_tpu.swarm.scenarios import run_scenario
+from upow_tpu.telemetry import exposition, metrics, tracing
+from upow_tpu.telemetry import events as events_mod
+from upow_tpu.telemetry.events import ROTATED_UNSEEN, EventRing
+from upow_tpu.telemetry.scope import TelemetryScope
+from upow_tpu.watchtower import (AlertManager, AlertRule,
+                                 BurnRateEvaluator, EwmaZScore,
+                                 RateTracker, SpikeDetector, StuckGauge,
+                                 WatchtowerEngine)
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    """Registries are process-global: isolate each test."""
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.configure()  # restore preregistered kernel families
+
+
+# ---------------------------------------------------------- detectors ----
+
+def test_rate_tracker_first_sample_reset_and_recovery():
+    r = RateTracker()
+    assert r.update(0.0, 100.0) is None           # no baseline yet
+    assert r.update(10.0, 150.0) == 5.0           # 50 over 10s
+    assert r.update(20.0, 40.0) is None           # counter reset
+    assert r.update(30.0, 60.0) == 2.0            # re-primed after reset
+    assert r.update(30.0, 70.0) is None           # dt <= 0 is unusable
+
+
+def test_ewma_zscore_drop_direction_and_exact_fire_point():
+    z = EwmaZScore(alpha=0.3, z_threshold=6.0, min_samples=8,
+                   direction="drop", min_sigma=0.25)
+    for _ in range(10):
+        out = z.update(10.0)
+        assert not out["fire"], "steady series must stay quiet"
+    out = z.update(0.0)
+    assert out["fire"] and out["z"] <= -6.0
+    # the score is taken against the PRE-update estimate: the mean the
+    # collapse was judged against is still ~10
+    assert out["mean"] == pytest.approx(10.0)
+
+
+def test_ewma_zscore_spike_mode_ignores_drops_and_min_samples_gate():
+    spike = EwmaZScore(min_samples=2, direction="spike")
+    out = None
+    for v in (5.0, 5.0, 0.0):
+        out = spike.update(v)
+    assert not out["fire"], "a drop must not fire in spike mode"
+    gated = EwmaZScore(min_samples=8, direction="both")
+    for v in (5.0, 5.0, 500.0):                   # only 3 samples seen
+        out = gated.update(v)
+    assert not out["fire"], "min_samples gates early wildness"
+
+
+def test_stuck_gauge_arms_only_after_movement_and_deadline_boundary():
+    g = StuckGauge(deadline_s=60.0)
+    assert not g.update(0.0, 5.0)                 # first sample
+    assert not g.update(1000.0, 5.0)              # never moved != stuck
+    assert not g.update(1010.0, 6.0)              # movement arms
+    assert not g.update(1069.0, 6.0)              # 59s: inside deadline
+    assert g.update(1070.0, 6.0)                  # 60s: stuck
+    assert not g.update(1071.0, 7.0)              # movement resolves
+
+
+def test_spike_detector_floor_ratio_and_allzero_series():
+    s = SpikeDetector(ratio=8.0, floor=100.0, min_samples=4)
+    for v in (10.0, 10.0, 10.0, 10.0):
+        assert not s.update(v)["fire"]
+    assert not s.update(50.0)["fire"]             # 5x but under floor
+    assert s.update(900.0)["fire"]                # >= 8x and >= floor
+    idle = SpikeDetector(ratio=8.0, floor=0.0, min_samples=4)
+    out = None
+    for _ in range(6):
+        out = idle.update(0.0)
+    assert not out["fire"], "an all-zero series is idle, not anomalous"
+
+
+# ----------------------------------------------------------- burn rate ----
+
+def _scaled_evaluator():
+    # window_scale 1/300 compresses the canonical SRE windows to
+    # (1s, 12s) fast and (6s, 72s) slow — same math, simulated seconds
+    return BurnRateEvaluator(slo_target=0.999, window_scale=1.0 / 300.0)
+
+
+def test_burnrate_error_burst_pages_fast_pair():
+    ev = _scaled_evaluator()
+    req = err = 0.0
+    t = 0.0
+    for _ in range(80):
+        t += 1.0
+        req += 100.0
+        ev.record(t, {"push_tx": (req, err)})
+    res = ev.evaluate(t)["push_tx"]
+    assert res["fast_short"] == 0.0 and not res["page"]
+    assert res["budget_remaining"] == 1.0
+    for _ in range(13):                           # 50% errors: 500x burn
+        t += 1.0
+        req += 100.0
+        err += 50.0
+        ev.record(t, {"push_tx": (req, err)})
+    res = ev.evaluate(t)["push_tx"]
+    assert res["page"]
+    assert res["fast_short"] == pytest.approx(500.0)
+    assert res["fast_long"] >= 14.4
+    assert res["budget_remaining"] < 0.0, "burst overspends the budget"
+
+
+def test_burnrate_drizzle_tickets_but_never_pages():
+    ev = _scaled_evaluator()
+    req = err = 0.0
+    t = 0.0
+    for _ in range(80):                           # 0.8% errors = 8x burn
+        t += 1.0
+        req += 1000.0
+        err += 8.0
+        ev.record(t, {"sync": (req, err)})
+    res = ev.evaluate(t)["sync"]
+    assert res["ticket"] and not res["page"]
+    assert res["slow_short"] == pytest.approx(8.0)
+
+
+def test_burnrate_none_without_baseline_or_traffic():
+    ev = _scaled_evaluator()
+    ev.record(0.0, {"idle": (100.0, 0.0)})
+    assert ev.burn("idle", 12.0, 0.5) is None, "baseline too young"
+    for tick in range(1, 40):                     # constant counters
+        ev.record(float(tick), {"idle": (100.0, 0.0)})
+    assert ev.burn("idle", 12.0, 39.0) is None, \
+        "zero requests inside the window is idleness, not an outage"
+
+
+# ------------------------------------------------- alert state machine ----
+
+def test_alert_for_duration_exemplar_dedup_and_resolve():
+    seen = []
+    mgr = AlertManager(history=8, emit=lambda st, a: seen.append((st, a.key)))
+    rule = AlertRule("r", severity="critical", for_s=10.0)
+    st = mgr.observe(rule, True, 100.0, value=1.0)
+    assert st.state == "pending" and not seen
+    mgr.observe(rule, True, 109.0)
+    assert mgr.counts(109.0)["firing"] == 0, "9s < for-duration 10s"
+    mgr.observe(rule, True, 110.0, exemplars=["t1", "t1", "t2"])
+    c = mgr.counts(110.0)
+    assert c["firing"] == 1 and c["firing_with_exemplars"] == 1
+    assert seen == [("firing", "r")]
+    assert mgr.active()[0].exemplars == ["t1", "t2"]
+    assert mgr.ack("r") and mgr.active()[0].acked
+    mgr.observe(rule, False, 120.0)
+    assert seen[-1] == ("resolved", "r")
+    assert mgr.fired_total == 1 and mgr.resolved_total == 1
+    # a pending that never fired evaporates without a resolve emission
+    mgr.observe(rule, True, 200.0)
+    mgr.observe(rule, False, 205.0)
+    assert mgr.resolved_total == 1 and not mgr.active()
+
+
+def test_alert_per_key_dedup_silence_and_expiry():
+    seen = []
+    mgr = AlertManager(history=8, emit=lambda st, a: seen.append((st, a.key)))
+    burn = AlertRule("burn", for_s=0.0)
+    mgr.observe(burn, True, 300.0, key="burn:a")
+    mgr.observe(burn, True, 300.0, key="burn:b")
+    assert mgr.counts(300.0)["firing"] == 2
+    assert [a.key for a in mgr.active()] == ["burn:a", "burn:b"]
+    mgr.silence("burn:a", until=400.0)
+    before = len(seen)
+    mgr.observe(burn, False, 350.0, key="burn:a")
+    assert len(seen) == before, "silenced transitions are not emitted"
+    mgr.silence("burn:b", until=360.0)
+    assert mgr.counts(355.0)["silenced"] == 1
+    assert mgr.counts(365.0)["silenced"] == 0, "silence auto-expires"
+    assert not mgr.ack("never-fired")
+
+
+# --------------------------------------------------- event ring cursor ----
+
+def test_event_ring_since_cursor_counts_rotated_records():
+    ring = EventRing(maxlen=4)
+    for i in range(6):
+        ring.emit("k", i=i)
+    got = ring.since(0)
+    assert got["next_seq"] == 6
+    assert got["missed"] == 2, "seqs 1-2 rotated away unseen"
+    assert [e["seq"] for e in got["events"]] == [3, 4, 5, 6]
+    again = ring.since(got["next_seq"])
+    assert again["events"] == [] and again["missed"] == 0
+    ring.emit("other")
+    ring.emit("k", i=9)
+    only_k = ring.since(6, kind="k")
+    assert [e["seq"] for e in only_k["events"]] == [8]
+    assert only_k["next_seq"] == 8
+
+
+def test_scoped_since_bumps_rotated_unseen_counter():
+    sc = TelemetryScope("t", events_buffer=4)
+    with sc.activate():
+        for i in range(6):
+            events_mod.emit("k", i=i)
+        got = events_mod.since(0)
+        assert got["missed"] == 2
+        assert sc.metrics.counters()[ROTATED_UNSEEN] == 2
+        events_mod.since(got["next_seq"])
+        assert sc.metrics.counters()[ROTATED_UNSEEN] == 2, \
+            "a cursor that kept up adds nothing"
+
+
+# -------------------------------------------------- exposition exemplars ----
+
+def test_histogram_exemplar_renders_and_validates():
+    name = "slo.http.push_tx.latency_seconds"
+    metrics.ensure_histogram(name, buckets=(0.1, 1.0))
+    metrics.observe(name, 0.05)
+    metrics.observe_exemplar(name, 0.05, "aabbccdd11223344")
+    h = metrics.histograms()[name]
+    assert h["exemplars"] == {0: {"trace_id": "aabbccdd11223344",
+                                  "value": 0.05}}
+    e = exposition.Exposition()
+    e.histogram(name, h["bounds"], h["counts"], h["count"], h["sum"],
+                exemplars=h.get("exemplars"))
+    text = e.render()
+    assert '# {trace_id="aabbccdd11223344"} 0.050000' in text
+    assert exposition.validate(text) == []
+
+    # uuid4-hex trace ids start with a digit half the time — the label
+    # VALUE must render verbatim, not name-sanitized into "_7..."
+    metrics.observe(name, 0.06)
+    metrics.observe_exemplar(name, 0.06, "70e0d1e0020f44bc")
+    h = metrics.histograms()[name]
+    e = exposition.Exposition()
+    e.histogram(name, h["bounds"], h["counts"], h["count"], h["sum"],
+                exemplars=h.get("exemplars"))
+    text = e.render()
+    assert '# {trace_id="70e0d1e0020f44bc"} 0.060000' in text
+    assert exposition.validate(text) == []
+
+
+def test_exemplar_prefers_slower_sample_within_bucket():
+    name = "h"
+    metrics.ensure_histogram(name, buckets=(1.0,))
+    metrics.observe(name, 0.9)
+    metrics.observe_exemplar(name, 0.9, "slow0000slow0000")
+    metrics.observe(name, 0.2)
+    metrics.observe_exemplar(name, 0.2, "fast0000fast0000")
+    ex = metrics.histograms()[name]["exemplars"]
+    assert ex[0]["trace_id"] == "slow0000slow0000", \
+        "the worst representative survives"
+
+
+def test_validator_rejects_exemplar_beyond_bucket_bound():
+    bad = ('m_bucket{le="0.1"} 1 # {trace_id="x"} 5.0\n'
+           'm_bucket{le="+Inf"} 1\n'
+           'm_sum 0.050000\n'
+           'm_count 1\n')
+    errs = exposition.validate(bad)
+    assert any("exceeds bucket" in e for e in errs), errs
+
+
+# --------------------------------------------------------- engine unit ----
+
+def _wt_cfg(**overrides) -> WatchtowerConfig:
+    cfg = WatchtowerConfig()
+    cfg.enabled = True
+    cfg.for_fast = 0.0              # page on the evaluation tick
+    cfg.breaker_storm_opens = 3
+    cfg.breaker_storm_window = 60.0
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_engine_breaker_storm_fires_with_exemplar_then_resolves():
+    async def main():
+        sc = TelemetryScope("n0")
+        eng = WatchtowerEngine(_wt_cfg(), scope=sc, name="n0")
+        base = time.time()
+        counts = await eng.evaluate_once(now=base)
+        assert counts["firing"] == 0
+
+        # breaker transitions emitted under a live trace carry its id;
+        # the storm alert must surface it as the incident exemplar
+        with tracing.request_trace("http.push_tx") as root:
+            tid = root.trace_id
+            for i in range(4):
+                sc.events.emit("breaker", peer=f"p{i}", state="open",
+                               previous="closed", failures=3)
+        counts = await eng.evaluate_once(now=time.time())
+        assert counts["firing"] == 1
+        alert = {a.rule.name: a for a in eng.alerts.active()}[
+            "breaker_flip_storm"]
+        assert alert.state == "firing" and alert.value == 4.0
+        assert tid in alert.exemplars
+        fired = sc.events.snapshot(kind="alert")
+        assert any(e["state"] == "firing" and e["node"] == "n0"
+                   and e["exemplar"] == tid for e in fired)
+
+        # aging the clock past the window empties the open-event deque
+        await eng.evaluate_once(
+            now=time.time() + eng.cfg.breaker_storm_window + 1.0)
+        assert not any(a.rule.name == "breaker_flip_storm"
+                       for a in eng.alerts.active())
+        st = eng.stats()
+        assert st["fired_total"] == 1 and st["resolved_total"] == 1
+
+    asyncio.run(main())
+
+
+def test_engine_counts_rotated_events_and_survives_bad_probes():
+    async def main():
+        sc = TelemetryScope("n0", events_buffer=4)
+        eng = WatchtowerEngine(_wt_cfg(), scope=sc, name="n0")
+        for i in range(10):
+            sc.events.emit("k", i=i)
+
+        def bad_probe():
+            raise RuntimeError("probe died")
+
+        eng.register_probe("mempool_depth", bad_probe)
+        eng.register_probe("sync_lag", lambda: 0.0)
+        await eng.evaluate_once(now=time.time())
+        assert sc.metrics.counters()[ROTATED_UNSEEN] == 6, \
+            "seqs 1-6 rotated out before the first cursor read"
+        assert eng.probe_errors == 1, "one dead probe, engine alive"
+        assert eng.evaluations == 1 and eng.eval_errors == 0
+
+    asyncio.run(main())
+
+
+def test_engine_slo_burn_pages_route_and_records_bench_event(tmp_path):
+    bench_path = tmp_path / "events.jsonl"
+
+    async def main():
+        sc = TelemetryScope("n0")
+        cfg = _wt_cfg(window_scale=1.0 / 300.0,
+                      bench_events=str(bench_path))
+        eng = WatchtowerEngine(cfg, scope=sc, name="n0")
+        fired = []
+        eng.on_fire.append(lambda a: fired.append(a.rule.name))
+        base = time.time()
+        t = base
+        for _ in range(20):                       # clean baseline
+            t += 1.0
+            sc.metrics.inc("slo.http.push_tx.requests", 100)
+            await eng.evaluate_once(now=t)
+        assert not any(a.rule.name == "slo_burn_fast"
+                       for a in eng.alerts.active())
+        for _ in range(14):                       # 50% errors
+            t += 1.0
+            sc.metrics.inc("slo.http.push_tx.requests", 100)
+            sc.metrics.inc("slo.http.push_tx.errors", 50)
+            await eng.evaluate_once(now=t)
+        keys = [a.key for a in eng.alerts.active()]
+        assert "slo_burn_fast:push_tx" in keys, keys
+        assert "slo_burn_fast" in fired, "on_fire callback saw the page"
+
+    asyncio.run(main())
+    lines = bench_path.read_text().strip().splitlines()
+    recs = [__import__("json").loads(ln) for ln in lines]
+    assert any(r["kind"] == "alert_fired" and r["rule"] == "slo_burn_fast"
+               and r["source"] == "watchtower" for r in recs)
+
+
+# ------------------------------------------------- recorder precedence ----
+
+def test_recorder_trigger_alert_outranks_fault_and_slo_breach():
+    evs = [{"kind": "fault_injected", "spec": "rpc:error"},
+           {"kind": "alert", "state": "firing",
+            "rule": "breaker_flip_storm"}]
+    slow = {"swarm.x.node0": {"p99_ms": 900.0}}
+    assert recorder.trigger_reason(True, evs, slo_rows=slow,
+                                   p99_budget_ms=100.0) \
+        == "alert:breaker_flip_storm"
+    pending_only = [{"kind": "alert", "state": "pending", "rule": "r"},
+                    {"kind": "fault_injected"}]
+    assert recorder.trigger_reason(True, pending_only) == "fault_injected"
+    assert recorder.trigger_reason(False, evs) == "core_assertion_failed"
+
+
+# ------------------------------------------------------- node surfaces ----
+
+def test_debug_alerts_metrics_families_and_events_cursor(tmp_path, keys):
+    """The node wires the watchtower end to end: /debug/alerts serves
+    the rule pack + operator knobs, /metrics exports the upow_alert_*
+    families and SLO bucket exemplars, /debug/events honors since=."""
+    async def scenario(cluster):
+        cfg = make_config(cluster.tmp_path, "wt")
+        cfg.watchtower.enabled = True
+        cfg.watchtower.interval = 3600.0          # pumped manually
+        from aiohttp.test_utils import TestClient, TestServer
+        from upow_tpu.node.app import Node
+        node = Node(cfg)
+        server = TestServer(node.app)
+        await server.start_server()
+        client = TestClient(server)
+        node.self_url = f"http://127.0.0.1:{server.port}"
+        node.started = True
+        cluster.nodes.append(node)
+        cluster.servers.append(server)
+        cluster.clients.append(client)
+
+        for _ in range(3):                        # traced SLO traffic
+            assert (await (await client.get("/get_supply_info")).json())["ok"]
+        await node.watchtower.evaluate_once()
+
+        res = await (await client.get("/debug/alerts")).json()
+        assert res["ok"]
+        r = res["result"]
+        assert r["enabled"] and r["stats"]["evaluations"] >= 1
+        assert {x["name"] for x in r["rules"]} >= {
+            "verify_throughput_collapse", "breaker_flip_storm",
+            "slo_burn_fast", "slo_burn_slow", "stuck_height"}
+        res = await (await client.get(
+            "/debug/alerts", params={"silence": "stuck_height",
+                                     "seconds": "60"})).json()
+        assert res["result"]["actions"] == {"silenced": "stuck_height"}
+
+        text = await (await client.get("/metrics")).text()
+        for family in ("upow_alert_firing ", "upow_alert_pending ",
+                       "upow_alert_silenced ",
+                       "upow_alert_evaluations_total ",
+                       "upow_telemetry_events_rotated_unseen_total "):
+            assert family in text, family
+        assert '# {trace_id="' in text, \
+            "SLO bucket exemplars must render on /metrics"
+        assert exposition.validate(text) == []
+
+        res = await (await client.get(
+            "/debug/events", params={"since": "0"})).json()
+        assert res["ok"] and "next_seq" in res and res["missed"] == 0
+        cursor = res["next_seq"]
+        res = await (await client.get(
+            "/debug/events", params={"since": str(cursor)})).json()
+        assert res["result"] == []
+        res = await client.get("/debug/events", params={"since": "x"})
+        assert res.status == 400
+
+    run_cluster(tmp_path, scenario)
+
+
+def test_debug_alerts_reports_disabled_but_families_still_export(
+        tmp_path, keys):
+    async def scenario(cluster):
+        node, client = await cluster.add_node("a")
+        assert node.watchtower is None
+        res = await (await client.get("/debug/alerts")).json()
+        assert res["ok"] and res["result"] == {"enabled": False}
+        text = await (await client.get("/metrics")).text()
+        assert "upow_alert_firing 0" in text, \
+            "alert families pin their names even with the engine off"
+
+    run_cluster(tmp_path, scenario)
+
+
+# ----------------------------------------------------- scenario legs ----
+
+def test_watchtower_storm_scenario_and_determinism():
+    """ISSUE 20 acceptance, adversarial direction: injected gossip
+    faults page breaker_flip_storm with a cross-node exemplar, the
+    flight recorder dumps with the alert as its trigger, the alert
+    resolves once the fault lifts — and the same seed reproduces the
+    core fingerprint byte-identically."""
+    art = run_scenario("watchtower_storm", seed=5)
+    core = art["core"]
+    assert core["baseline_clean"], "clean tick must not page"
+    assert core["storm_alert_fired"]
+    assert core["storm_rule"] == "breaker_flip_storm"
+    assert core["storm_severity"] == "critical"
+    assert core["exemplar_present"]
+    assert core["exemplar_stitched"], "exemplar trace crosses >= 2 nodes"
+    assert core["alert_event_emitted"]
+    assert core["fault_events_seen"]
+    assert core["alert_resolved"]
+    assert core["converged"]
+    assert len(art["observed"]["stitched_nodes"]) >= 2
+    fr = art.get("flight_recorder")
+    assert fr is not None, "alert must trip the black box"
+    assert fr["reason"] == "alert:breaker_flip_storm"
+
+    again = run_scenario("watchtower_storm", seed=5)
+    assert again["fingerprint"] == art["fingerprint"]
+    assert again["core"] == core
+
+
+def test_geo_soak_clean_run_fires_zero_alerts():
+    """ISSUE 20 acceptance, clean direction: the production rule pack
+    armed on every geo-soak node stays silent through latency skew,
+    churn and a partition/heal — and the enforced fleet kernel row
+    zeroes if that ever regresses."""
+    art = run_scenario("geo_soak", seed=5)
+    core = art["core"]
+    assert core["watchtower_armed_all_nodes"]
+    assert core["watchtower_ticked"]
+    assert core["watchtower_zero_alerts"]
+    wt = art["observed"]["watchtower"]
+    assert wt["ticks"] >= 1 and wt["fired"] == 0
+    assert "flight_recorder" not in art
+
+    rows = fleet_rows(art)
+    k = rows["kernels"]["watchtower_clean_ok"]
+    assert k["value"] == 1.0 and k["direction"] == "higher"
+    broken = {**art, "core": {**art["core"],
+                              "watchtower_zero_alerts": False}}
+    assert fleet_rows(broken)["kernels"]["watchtower_clean_ok"]["value"] \
+        == 0.0

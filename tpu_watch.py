@@ -107,6 +107,37 @@ def _record_event(kind: str, **fields) -> None:
         _log(f"event record failed: {e}")
 
 
+def _alert_summary(since_ts: str = "") -> None:
+    """Surface watchtower ``alert_fired`` records from the shared event
+    log (upow_tpu/watchtower/benchlog.py appends them when a node under
+    bench load pages): incidents are easy to miss between probe
+    chatter, so the watcher repeats them at start and queue end."""
+    try:
+        with open(_EVENTS) as f:
+            lines = f.readlines()
+    except OSError:
+        return
+    fired = []
+    for ln in lines:
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        if rec.get("kind") != "alert_fired":
+            continue
+        if since_ts and rec.get("ts", "") < since_ts:
+            continue
+        fired.append(rec)
+    if not fired:
+        return
+    _log(f"watchtower: {len(fired)} alert_fired record(s) in "
+         f"{os.path.basename(_EVENTS)}")
+    for rec in fired[-5:]:
+        _log(f"  alert {rec.get('rule')} severity={rec.get('severity')} "
+             f"value={rec.get('value')} ts={rec.get('ts')} "
+             f"exemplar={rec.get('exemplar_trace_id')}")
+
+
 def _load_state() -> dict:
     try:
         with open(_STATE) as f:
@@ -210,6 +241,11 @@ def main() -> int:
     state = _load_state()
     state.setdefault("attempts", {})
     _log(f"watcher up (pid {os.getpid()}), done={state['done']}")
+    # queue children (bench/suite soaks) route watchtower pages into
+    # the shared event log; surface anything already recorded there
+    os.environ.setdefault("UPOW_WATCHTOWER_BENCH_EVENTS", _EVENTS)
+    campaign_start = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+    _alert_summary()
     probe_failures = 0
     while True:
         pending = [(n, a, d) for n, a, d in _QUEUE
@@ -218,6 +254,7 @@ def main() -> int:
         if not pending:
             exhausted = [n for n, *_ in _QUEUE if n not in state["done"]]
             _log(f"queue complete; exhausted={exhausted}; exiting")
+            _alert_summary(since_ts=campaign_start)
             return 0 if not exhausted else 2
         if _probe():
             probe_failures = 0

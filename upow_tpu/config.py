@@ -401,6 +401,47 @@ class TelemetryConfig:
 
 
 @dataclass
+class WatchtowerConfig:
+    """Streaming alerting engine (upow_tpu/watchtower/) — operational
+    only, never consensus.  Overridable as ``UPOW_WATCHTOWER_<FIELD>``.
+
+    Defaults describe the standing rule pack (docs/ALERTING.md):
+    verify-throughput collapse, mempool depth spike, sync lag, breaker
+    flip storm, ws drop rate, device arm flaps, stuck block height,
+    and per-route SLO burn rates.  Thresholds are deliberately
+    conservative — the clean seeded geo-soak must fire zero alerts."""
+
+    enabled: bool = False           # run the evaluation task on this node
+    interval: float = 5.0           # evaluation cadence, seconds
+    # SLO burn-rate (burnrate.py): canonical 5m/1h + 30m/6h pairs,
+    # compressible for scenarios via window_scale.
+    slo_target: float = 0.999
+    fast_burn: float = 14.4
+    slow_burn: float = 6.0
+    window_scale: float = 1.0
+    # for-durations: fast rules page quickly, slow rules must sustain.
+    for_fast: float = 15.0
+    for_slow: float = 60.0
+    # rule thresholds
+    verify_min_rate: float = 1.0    # submissions/s EWMA floor before the
+                                    # collapse rule may judge a drop
+    verify_z: float = 6.0           # z-score magnitude for rate anomalies
+    mempool_spike_ratio: float = 8.0
+    mempool_spike_floor: float = 1000.0
+    sync_lag_limit: float = 600.0   # seconds behind tip timestamp
+    breaker_storm_window: float = 60.0
+    breaker_storm_opens: int = 6    # breaker open transitions in window
+    ws_drop_limit: float = 50.0     # dropped ws messages per second
+    arm_flap_window: float = 600.0
+    arm_flaps: int = 3              # degrade/arm-failure events in window
+    stuck_height_deadline: float = 300.0
+    history: int = 64               # firing/resolved transition ring
+    bench_events: str = ""          # append alert_fired JSONL records to
+                                    # this path (bench harnesses point it
+                                    # at .bench_events.jsonl)
+
+
+@dataclass
 class ProfilingConfig:
     """Opt-in performance capture (upow_tpu/profiling/) — all off by
     default; overridable as ``UPOW_PROFILE_<FIELD>``."""
@@ -431,6 +472,7 @@ class Config:
     snapshot: SnapshotConfig = field(default_factory=SnapshotConfig)
     archive: ArchiveConfig = field(default_factory=ArchiveConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    watchtower: WatchtowerConfig = field(default_factory=WatchtowerConfig)
     profile: ProfilingConfig = field(default_factory=ProfilingConfig)
 
     @classmethod
@@ -475,7 +517,7 @@ def _merge_dict(cfg: Config, data: dict) -> Config:
 def _merge_env(cfg: Config) -> Config:
     for section in ("device", "device_runtime", "node", "ws", "miner",
                     "log", "resilience", "mempool", "cache", "snapshot",
-                    "archive", "telemetry", "profile"):
+                    "archive", "telemetry", "watchtower", "profile"):
         _apply_env_fields(getattr(cfg, section), section)
     return cfg
 

@@ -55,6 +55,17 @@ def continent_of(i: int) -> str:
     return CONTINENTS[i % len(CONTINENTS)]
 
 
+def geo_soak_cfg(i: int, cfg) -> None:
+    """Arm the watchtower on every soak node with the PRODUCTION rule
+    pack — default thresholds, real background cadence (tightened to
+    1s so a ~15s soak still gets a dozen ticks).  The clean-run gate
+    (``watchtower_clean_ok``) is adversarial in the other direction:
+    a healthy fleet doing churn, partitions and reorgs must not page,
+    or the rule pack is too twitchy to ship."""
+    cfg.watchtower.enabled = True
+    cfg.watchtower.interval = 1.0
+
+
 def _shape_links(swarm) -> Dict[str, str]:
     """Apply the continent latency matrix; returns {node label: continent}.
 
@@ -185,6 +196,14 @@ async def scenario_geo_soak(swarm, seed: int):
     await swarm.settle()          # drain gossip before teardown
     rec.mark(swarm, label="confirm")
 
+    # ---- watchtower quiet check: the live cadence loops ran the whole
+    # soak; a healthy fleet must end it without a single fired alert
+    wt_stats = {f"node{i}": node.watchtower.stats()
+                for i, node in enumerate(swarm.nodes)
+                if getattr(node, "watchtower", None) is not None}
+    wt_ticks = sum(s["evaluations"] for s in wt_stats.values())
+    wt_fired = sum(s["fired_total"] for s in wt_stats.values())
+
     tips = await swarm.tips()
     prop = propagation.report(scrape.events_by_node(swarm), n_nodes=n)
     # blocks that must reach EVERY node: 2 bootstrap + 4 waves +
@@ -205,6 +224,9 @@ async def scenario_geo_soak(swarm, seed: int):
         "blocks_covered_90pct": prop["blocks"]["covered"]
         >= covered_expected,
         "final_converged": final_converged,
+        "watchtower_armed_all_nodes": len(wt_stats) == n,
+        "watchtower_ticked": wt_ticks >= 1,
+        "watchtower_zero_alerts": wt_fired == 0,
         "final_height": tips[0]["id"],
         "final_tip": tips[0]["hash"],
     }
@@ -214,6 +236,8 @@ async def scenario_geo_soak(swarm, seed: int):
         "push_tx_trace_id": push_tid,
         "tx_pool_nodes": tx_nodes,
         "waves_propagated": waves_propagated,
+        "watchtower": {"ticks": wt_ticks, "fired": wt_fired,
+                       "stats": wt_stats},
     }
     return core, observed
 
@@ -245,11 +269,20 @@ def fleet_rows(art: dict) -> dict:
 
     prop = art["observed"]["propagation"]
     ok = core_ok(art["core"])
+    wt_clean = bool(
+        art["core"].get("watchtower_armed_all_nodes")
+        and art["core"].get("watchtower_ticked")
+        and art["core"].get("watchtower_zero_alerts"))
     kernels = {
         "fleet_core_ok": {
             "value": 1.0 if ok else 0.0, "unit": "bool",
             "direction": "higher",
             "desc": "geo-soak core assertions all held (0 = broken)"},
+        "watchtower_clean_ok": {
+            "value": 1.0 if wt_clean else 0.0, "unit": "bool",
+            "direction": "higher",
+            "desc": "default rule pack armed + ticking on every soak "
+                    "node and ZERO alerts fired on the clean run"},
         "fleet_block_prop_p50_ms": {
             "value": _num(prop["blocks"]["p50_ms"]), "unit": "ms",
             "direction": "lower",
@@ -293,6 +326,7 @@ def observatory_section(nodes: int = GEO_NODES,
                    ("hashes", "covered", "p50_ms", "p95_ms", "p99_ms")}
             for kind in ("blocks", "txs")},
         "stitched_push_tx_nodes": stitched.get("node_count", 0),
+        "watchtower": art["observed"].get("watchtower", {}),
         "flight_recorder": art.get("flight_recorder", {}).get("reason"),
     }
     return {"section": section, "kernels": rows["kernels"],

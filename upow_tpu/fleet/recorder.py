@@ -74,9 +74,17 @@ class FlightRecorder:
 def trigger_reason(core_ok: bool, events: List[dict],
                    slo_rows: Optional[Dict[str, dict]] = None,
                    p99_budget_ms: Optional[float] = None) -> Optional[str]:
-    """Why (if at all) the black box should land in the artifact."""
+    """Why (if at all) the black box should land in the artifact.
+
+    Precedence: a failed core assertion explains everything else; a
+    watchtower alert that reached *firing* outranks the raw fault that
+    (usually) provoked it — the alert is the judged incident, the fault
+    the mechanism; an injected fault outranks a soft SLO breach."""
     if not core_ok:
         return "core_assertion_failed"
+    for e in events:
+        if e.get("kind") == "alert" and e.get("state") == "firing":
+            return f"alert:{e.get('rule')}"
     for e in events:
         if e.get("kind") == "fault_injected":
             return "fault_injected"

@@ -217,6 +217,7 @@ class Node:
         self._last_mempool_clean: Optional[float] = None  # monotonic
         self._closing = False
         self._background: set = set()
+        self._services: set = set()  # perpetual loops (watchtower)
         self._http_session = None  # shared gossip/RPC session, lazy
         self.ws_hub = None  # set by ws.attach(...) when enabled
         # Outbound RPC client seam: everything that talks to a peer
@@ -272,7 +273,45 @@ class Node:
                 self._snapshot_rebuild_tick()
 
             self.manager.on_state_committed = _committed
+        # Watchtower (docs/ALERTING.md): streaming anomaly detection +
+        # SLO burn-rate alerting over this node's telemetry registries.
+        # The engine holds direct registry references (scope or process
+        # globals), so swarm nodes alert strictly independently; live
+        # gauges the registries don't store come in through probes.
+        self.watchtower = None
+        if self.config.watchtower.enabled:
+            from ..watchtower import WatchtowerEngine
+
+            self.watchtower = WatchtowerEngine(
+                self.config.watchtower, scope=self.telemetry_scope,
+                name=(self.telemetry_scope.name
+                      if self.telemetry_scope else "node"))
+            self._register_watchtower_probes()
         self.app = self._build_app()
+
+    def _register_watchtower_probes(self) -> None:
+        wt = self.watchtower
+
+        async def block_height() -> float:
+            return float(await self.state.get_next_block_id() - 1)
+
+        async def sync_lag() -> float:
+            last = await self.state.get_last_block()
+            return float(max(0, timestamp() - last["timestamp"])) \
+                if last else 0.0
+
+        wt.register_probe("block_height", block_height)
+        wt.register_probe("sync_lag", sync_lag)
+        if self.config.mempool.enabled:
+            wt.register_probe("mempool_depth",
+                              lambda: float(len(self.pool)))
+
+        def ws_dropped() -> float:
+            # ws_hub attaches later in _build_app; resolve per call
+            hub = self.ws_hub
+            return float(hub.get_stats()["dropped_messages"]) if hub else 0.0
+
+        wt.register_probe("ws_dropped", ws_dropped)
 
     # ----------------------------------------------------------- plumbing --
     def _spawn(self, coro) -> None:
@@ -287,6 +326,18 @@ class Node:
         self._background.add(task)
         task.add_done_callback(self._background.discard)
 
+    def _spawn_service(self, coro) -> None:
+        """Long-lived service loop (watchtower cadence).  Tracked apart
+        from ``_background``: drain-style waiters (Swarm.settle) gather
+        the background set and a loop that never returns would deadlock
+        them.  Services only end via cancellation in close()."""
+        if self._closing:
+            coro.close()
+            return
+        task = asyncio.ensure_future(coro)
+        self._services.add(task)
+        task.add_done_callback(self._services.discard)
+
     async def close(self) -> None:
         self._closing = True
         # cancel AND await: a cancelled task only unwinds at its next
@@ -295,12 +346,12 @@ class Node:
         # inside run_in_executor (device verify) cannot be cancelled
         # until the executor call returns, and shutdown must not wait
         # out a 240 s device timeout.
-        for task in list(self._background):
+        closing = list(self._background) + list(self._services)
+        for task in closing:
             task.cancel()
         done, stragglers = set(), set()
-        if self._background:
-            done, stragglers = await asyncio.wait(
-                list(self._background), timeout=5.0)
+        if closing:
+            done, stragglers = await asyncio.wait(closing, timeout=5.0)
             for task in stragglers:
                 log.warning("background task still running at close: %r",
                             task)
@@ -493,7 +544,8 @@ class Node:
         except _BadParam as e:
             if slo_t0 is not None:
                 telemetry.slo.observe_request(
-                    normalized, time.perf_counter() - slo_t0, 422)
+                    normalized, time.perf_counter() - slo_t0, 422,
+                    trace_id=trace_id)
             return web.json_response(
                 {"ok": False, "error": f"Invalid integer parameter {e}"},
                 status=422)
@@ -502,13 +554,15 @@ class Node:
                       e, exc_info=True)
             if slo_t0 is not None:
                 telemetry.slo.observe_request(
-                    normalized, time.perf_counter() - slo_t0, 500)
+                    normalized, time.perf_counter() - slo_t0, 500,
+                    trace_id=trace_id)
             return web.json_response(
                 {"ok": False, "error": f"Uncaught {type(e).__name__} exception"},
                 status=500)
         if slo_t0 is not None:
             telemetry.slo.observe_request(
-                normalized, time.perf_counter() - slo_t0, response.status)
+                normalized, time.perf_counter() - slo_t0, response.status,
+                trace_id=trace_id)
         response.headers["Access-Control-Allow-Origin"] = "*"
         if trace_id is not None:
             response.headers[telemetry.TRACE_HEADER] = trace_id
@@ -896,13 +950,51 @@ class Node:
             for key, value in sorted(costs.items()):
                 e.gauge(f"kernel_{kern}_cost_{key}", value,
                         "XLA compiled.cost_analysis() estimate")
+        # alert families are emitted unconditionally (zeros when the
+        # watchtower is off) so make metrics-check can pin their names
+        wt = self.watchtower
+        wrow = wt.metric_rows() if wt is not None else {}
+        e.gauge("alert_firing", wrow.get("firing", 0),
+                "Alerts currently firing (docs/ALERTING.md)")
+        e.gauge("alert_pending", wrow.get("pending", 0),
+                "Alert conditions inside their for-duration")
+        e.gauge("alert_silenced", wrow.get("silenced", 0),
+                "Active alerts suppressed by an operator silence")
+        e.gauge("alert_exemplars_firing",
+                wrow.get("firing_with_exemplars", 0),
+                "Firing alerts carrying at least one exemplar trace id")
+        e.gauge("alert_eval_lag_seconds",
+                wrow.get("eval_lag_seconds", 0.0),
+                "Wall seconds the last watchtower evaluation tick took")
+        e.counter("alert_evaluations", wrow.get("evaluations", 0),
+                  "Watchtower evaluation ticks since start")
+        e.counter("alert_fired", wrow.get("fired_total", 0),
+                  "pending->firing transitions since start")
+        e.counter("alert_resolved", wrow.get("resolved_total", 0),
+                  "firing->resolved transitions since start")
+        if wt is not None:
+            by_rule: dict = {}
+            for a in wt.alerts.active():
+                d = by_rule.setdefault(a.rule.name,
+                                       {"firing": 0, "pending": 0})
+                if a.state in d:
+                    d[a.state] += 1
+            for rname, rule in sorted(wt.rules.items()):
+                d = by_rule.get(rname, {"firing": 0, "pending": 0})
+                e.gauge(f"alert_rule_{rname}_{rule.severity}_firing",
+                        d["firing"],
+                        f"Firing alerts for rule {rname}")
+                e.gauge(f"alert_rule_{rname}_{rule.severity}_pending",
+                        d["pending"],
+                        f"Pending alerts for rule {rname}")
         for name, value in sorted(trace.counters().items()):
             e.counter(name, value)
         for name, s in sorted(trace.stats().items()):
             e.span_stats(name, s)
         for name, h in sorted(trace.histograms().items()):
             e.histogram(name, h["bounds"], h["counts"],
-                        h["count"], h["sum"])
+                        h["count"], h["sum"],
+                        exemplars=h.get("exemplars"))
         resp = web.Response(text=e.render())
         # full 0.0.4 content type (Prometheus requires the version
         # parameter; aiohttp's ctor only takes the bare mime type)
@@ -966,17 +1058,71 @@ class Node:
 
     async def h_debug_events(self, request: web.Request) -> web.Response:
         """Structured event ring: reorgs, breaker trips, degrade
-        transitions, fault injections — oldest first, each stamped with
-        the trace ID active when it fired."""
+        transitions, fault injections, alerts — oldest first, each
+        stamped with the trace ID active when it fired and a monotonic
+        ``seq``.  ``since=<seq>`` turns the poll incremental: only
+        records beyond the cursor return, plus ``next_seq`` (the next
+        cursor) and ``missed`` (records that rotated out of the ring
+        before this cursor saw them; also counted into the
+        ``telemetry.events.rotated_unseen`` counter)."""
         params = request.rel_url.query
         limit, err = self._debug_limit(params)
         if err is not None:
             return err
         kind = params.get("kind")
+        since_raw = params.get("since")
+        if since_raw is not None and since_raw != "":
+            try:
+                since_v = int(since_raw)
+            except ValueError:
+                return web.json_response(
+                    {"ok": False, "error": "since must be an integer"},
+                    status=400)
+            got = telemetry.events.since(since_v, limit=limit or None,
+                                         kind=kind)
+            return web.json_response({
+                "ok": True, "result": got["events"],
+                "next_seq": got["next_seq"], "missed": got["missed"]})
         return web.json_response({
             "ok": True,
             "result": telemetry.events.snapshot(limit=limit or None,
                                                 kind=kind)})
+
+    async def h_debug_alerts(self, request: web.Request) -> web.Response:
+        """Watchtower surface (docs/ALERTING.md): the rule pack, active
+        alert states with exemplar trace ids, the firing/resolved
+        history ring, burn-rate readings, and operator knobs —
+        ``?silence=<key>&seconds=<s>``, ``?unsilence=<key>``,
+        ``?ack=<key>``.  ``{"enabled": false}`` when the watchtower is
+        off (UPOW_WATCHTOWER_ENABLED=1 turns it on)."""
+        wt = self.watchtower
+        if wt is None:
+            return web.json_response(
+                {"ok": True, "result": {"enabled": False}})
+        q = request.rel_url.query
+        actions = {}
+        key = q.get("silence")
+        if key:
+            try:
+                secs = float(q.get("seconds", "300"))
+            except ValueError:
+                return web.json_response(
+                    {"ok": False, "error": "seconds must be a number"},
+                    status=400)
+            wt.silence(key, secs)
+            actions["silenced"] = key
+        key = q.get("unsilence")
+        if key:
+            wt.alerts.unsilence(key)
+            actions["unsilenced"] = key
+        key = q.get("ack")
+        if key:
+            actions["acked"] = wt.ack(key)
+        result = wt.snapshot()
+        result["enabled"] = True
+        if actions:
+            result["actions"] = actions
+        return web.json_response({"ok": True, "result": result})
 
     async def h_debug_cache(self, request: web.Request) -> web.Response:
         """Hot-state read cache introspection: per-class entry counts
@@ -2267,6 +2413,7 @@ class Node:
         if self.config.telemetry.debug_endpoints:
             r.add_get("/debug/traces", self.h_debug_traces)
             r.add_get("/debug/events", self.h_debug_events)
+            r.add_get("/debug/alerts", self.h_debug_alerts)
             r.add_get("/debug/breakers", self.h_debug_breakers)
             r.add_get("/debug/cache", self.h_debug_cache)
             r.add_get("/debug/archive", self.h_debug_archive)
@@ -2290,6 +2437,15 @@ class Node:
                 telemetry.slo.preregister(self._slo_paths)
         else:
             telemetry.slo.preregister(self._slo_paths)
+        if self.watchtower is not None:
+            # the cadence task starts with the app (TestServer/AppRunner
+            # both run on_startup) and dies with the service set in
+            # close(); scenarios that pump evaluate_once() manually set
+            # a huge interval so this loop never races them
+            async def _start_watchtower(_app) -> None:
+                self._spawn_service(self.watchtower.run())
+
+            app.on_startup.append(_start_watchtower)
         return app
 
 

@@ -891,6 +891,120 @@ async def scenario_archive_prune(swarm: Swarm, seed: int):
             None, lambda: shutil.rmtree(tmp, ignore_errors=True))
 
 
+def _watchtower_storm_cfg(i: int, cfg) -> None:
+    """Arm the watchtower on every node with the evaluation cadence
+    parked (the scenario pumps ``evaluate_once`` itself, so firing
+    order is a function of the seed, not the event loop) and the storm
+    rule tightened to swarm scale: 4 breaker opens page immediately."""
+    wt = cfg.watchtower
+    wt.enabled = True
+    wt.interval = 3600.0          # background loop never ticks
+    wt.for_fast = 0.0             # storm pages on the evaluation tick
+    wt.breaker_storm_opens = 4
+    wt.breaker_storm_window = 120.0
+
+
+async def scenario_watchtower_storm(swarm: Swarm, seed: int):
+    """Fault → alert → exemplar: every gossip RPC toward node 2 errors,
+    so node 0's breaker trips and then re-trips on each half-open
+    trial; the watchtower's ``breaker_flip_storm`` rule must reach
+    *firing* with an exemplar trace id that stitches across >= 2 nodes
+    (the guilty push propagated to node 1 fine), the flight recorder
+    must dump with the alert — not the raw fault — as the trigger, and
+    once the fault lifts and the event window ages out the alert must
+    resolve.  docs/ALERTING.md walks this exact incident."""
+    from ..wallet.builders import WalletBuilder
+
+    assert swarm.n >= 3, "watchtower_storm needs 3 nodes"
+    engine = swarm.nodes[0].watchtower
+    assert engine is not None, "cfg hook did not enable the watchtower"
+
+    d_f, addr_f = _wallet(seed, "storm_funder")
+    _, addr_t = _wallet(seed, "storm_target")
+    everyone = list(range(swarm.n))
+    for _ in range(8):            # one coinbase per later push
+        assert (await swarm.mine(0, addr_f, push_to=everyone))["ok"]
+    await swarm.settle()
+
+    # prime the streaming detectors: a clean tick must not page
+    baseline = await engine.evaluate_once(now=time.time())
+    baseline_clean = (baseline["firing"] == 0
+                      and baseline["pending"] == 0)
+
+    # every RPC whose peer key contains node 2's address errors; the
+    # driver's own requests bypass the resilience wrapper, so only
+    # node-to-node gossip feels it
+    faultinject.install(f"rpc:error:key={swarm.ips[2]}", seed)
+    builder = WalletBuilder(swarm.nodes[0].state)
+    rounds = 0
+    for k in range(7):
+        tx = await builder.create_transaction(d_f, addr_t, "1")
+        res = await swarm.get(0, "push_tx", {"tx_hex": tx.hex()})
+        assert res.get("ok"), res
+        rounds += 1
+        # outlive breaker_open_secs (0.25) so the next push lands on a
+        # half-open breaker and the failed trial re-opens it — each
+        # round past the failure threshold is one more "open" event
+        await asyncio.sleep(BREAKER_REOPEN_PAUSE)
+
+    storm_now = time.time()
+    counts = await engine.evaluate_once(now=storm_now)
+    active = {a.rule.name: a for a in engine.alerts.active()}
+    alert = active.get("breaker_flip_storm")
+    # Alert objects mutate in place on later ticks — freeze the storm-
+    # time view before the resolve leg flips it
+    storm_state = alert.state if alert else None
+    storm_opens = alert.value if alert else 0.0
+    exemplar = alert.exemplars[0] if alert and alert.exemplars else None
+    stitched_nodes = sorted({r["node"]
+                             for r in _roots_for(swarm, exemplar)}) \
+        if exemplar else []
+    node0_events = swarm.nodes[0].telemetry_scope.events.snapshot()
+
+    # lift the fault; aging the evaluation clock past the storm window
+    # empties the open-event window and the alert must resolve
+    faultinject.uninstall()
+    fired_before = engine.stats()["fired_total"]
+    await engine.evaluate_once(
+        now=storm_now + engine.cfg.breaker_storm_window + 1.0)
+    resolved = engine.stats()["resolved_total"] >= 1 and not any(
+        a.rule.name == "breaker_flip_storm" for a in engine.alerts.active())
+
+    await asyncio.sleep(BREAKER_REOPEN_PAUSE)  # node 2's breakers heal
+    assert (await swarm.mine(0, addr_f, push_to=everyone))["ok"]
+    await swarm.settle()
+    converged = await swarm.wait_converged()
+    tips = await swarm.tips()
+    core = {
+        "baseline_clean": baseline_clean,
+        "storm_alert_fired": storm_state == "firing",
+        "storm_rule": alert.rule.name if alert else None,
+        "storm_severity": alert.rule.severity if alert else None,
+        "exemplar_present": exemplar is not None,
+        "exemplar_stitched": len(stitched_nodes) >= 2,
+        "alert_event_emitted": any(
+            e.get("kind") == "alert" and e.get("state") == "firing"
+            and e.get("rule") == "breaker_flip_storm"
+            for e in node0_events),
+        "fault_events_seen": any(e.get("kind") == "fault_injected"
+                                 for e in node0_events),
+        "alert_resolved": resolved,
+        "converged": converged,
+        "final_height": tips[0]["id"],
+        "final_tip": tips[0]["hash"],
+    }
+    observed = {
+        "rounds": rounds,
+        "firing_counts": counts,
+        "breaker_opens_windowed": storm_opens,
+        "exemplar": exemplar,
+        "stitched_nodes": stitched_nodes,
+        "fired_total": fired_before,
+        "watchtower_stats": engine.stats(),
+    }
+    return core, observed
+
+
 # ------------------------------------------------------------- registry ----
 
 @dataclass(frozen=True)
@@ -931,6 +1045,9 @@ SCENARIOS: Dict[str, ScenarioSpec] = {
         topology="isolated",
         swarm_kwargs={"reorg_window": 4,
                       "cfg_hook": _archive_prune_cfg}),
+    "watchtower_storm": ScenarioSpec(
+        scenario_watchtower_storm, nodes=3, fast=True,
+        swarm_kwargs={"cfg_hook": _watchtower_storm_cfg}),
 }
 
 # The geo soak lives in the fleet package (fleet/geosoak.py: continent
@@ -938,11 +1055,12 @@ SCENARIOS: Dict[str, ScenarioSpec] = {
 # the matrix/CLI/artifact machinery treats it like any other scenario.
 # Import placed AFTER the registry: geosoak defers every swarm import
 # to call time, so this is the only edge and cannot cycle.
-from ..fleet.geosoak import scenario_geo_soak  # noqa: E402
+from ..fleet.geosoak import geo_soak_cfg, scenario_geo_soak  # noqa: E402
 
 SCENARIOS["geo_soak"] = ScenarioSpec(
     scenario_geo_soak, nodes=6, fast=True,
-    swarm_kwargs={"reorg_window": 4}, p99_budget_ms=2000.0)
+    swarm_kwargs={"reorg_window": 4, "cfg_hook": geo_soak_cfg},
+    p99_budget_ms=2000.0)
 
 
 # ------------------------------------------------------------- artifact ----

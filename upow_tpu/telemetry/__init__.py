@@ -60,6 +60,9 @@ def configure(cfg=None) -> None:
                           slowest=cfg.trace_slowest,
                           max_spans=cfg.max_trace_spans)
         events.configure(cfg.events_buffer)
+    # incremental event-cursor loss counter (events.since): exported
+    # all-zero from scrape #1 so metrics-check can pin the name
+    metrics.ensure_counter(events.ROTATED_UNSEEN)
     device.preregister("p256_verify")
     device.preregister("sha256_txid")
     device.preregister_runtime()

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import math
 import re
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -29,6 +29,13 @@ _SAMPLE_RE = re.compile(
     r"\s+(\S+)"                               # value
     r"(?:\s+\S+)?$")                          # optional timestamp
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+# OpenMetrics-style exemplar suffix: ` # {label="..."} value [ts]`.
+# Strictly an extension of the 0.0.4 grammar — rendered only on bucket
+# lines that carry an attached exemplar; validate() accepts and checks
+# it (label grammar, float value, value within the bucket's le bound).
+_EXEMPLAR_RE = re.compile(
+    r"^\{((?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\"(?:,\s*)?)*)\}"
+    r"\s+(\S+)(?:\s+\S+)?$")
 
 
 def sanitize(name: str) -> str:
@@ -37,6 +44,14 @@ def sanitize(name: str) -> str:
     if not safe or not _NAME_RE.match(safe):
         safe = "_" + safe
     return safe
+
+
+def _escape_label(value: str) -> str:
+    """Escape a label *value* (backslash, quote, newline).  Label values
+    take the full escaped grammar — running them through :func:`sanitize`
+    would corrupt digit-leading trace ids with a ``_`` prefix."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def _fmt(value: float) -> str:
@@ -73,18 +88,34 @@ class Exposition:
 
     def histogram(self, name: str, bounds: Sequence[float],
                   counts: Sequence[int], total: float, summed: float,
-                  help_text: str = "") -> None:
-        """``counts`` per-bucket with +Inf overflow last (registry shape)."""
+                  help_text: str = "",
+                  exemplars: Optional[Dict[int, dict]] = None) -> None:
+        """``counts`` per-bucket with +Inf overflow last (registry shape).
+
+        ``exemplars`` maps bucket index (0..len(bounds), +Inf last) to
+        ``{"trace_id", "value"}``; a bucket with one gets the
+        OpenMetrics exemplar suffix ``# {trace_id="..."} value``."""
         full = self._name(name)
         if help_text:
             self.lines.append(f"# HELP {full} {help_text}")
         self.lines.append(f"# TYPE {full} histogram")
+        ex = exemplars or {}
+
+        def _suffix(idx: int) -> str:
+            e = ex.get(idx)
+            if not e or not e.get("trace_id"):
+                return ""
+            return (f' # {{trace_id="{_escape_label(str(e["trace_id"]))}"}}'
+                    f' {e["value"]:.6f}')
+
         cum = 0
-        for bound, count in zip(bounds, counts):
+        for i, (bound, count) in enumerate(zip(bounds, counts)):
             cum += count
-            self.lines.append(f'{full}_bucket{{le="{bound}"}} {cum}')
+            self.lines.append(
+                f'{full}_bucket{{le="{bound}"}} {cum}{_suffix(i)}')
         cum += counts[-1]
-        self.lines.append(f'{full}_bucket{{le="+Inf"}} {cum}')
+        self.lines.append(
+            f'{full}_bucket{{le="+Inf"}} {cum}{_suffix(len(bounds))}')
         self.lines.append(f"{full}_sum {summed:.6f}")
         self.lines.append(f"{full}_count {int(total)}")
 
@@ -126,11 +157,33 @@ def validate(text: str) -> List[str]:
                 errors.append(
                     f"line {lineno}: illegal metric name {parts[2]!r}")
             continue
+        exemplar_raw = None
+        cut = line.find(" # {")
+        if cut != -1:
+            exemplar_raw = line[cut + 3:]
+            line = line[:cut]
         m = _SAMPLE_RE.match(line)
         if m is None:
             errors.append(f"line {lineno}: unparseable sample {line!r}")
             continue
         name, labels_raw, value_raw = m.group(1), m.group(2), m.group(3)
+        exemplar_value = None
+        if exemplar_raw is not None:
+            em = _EXEMPLAR_RE.match(exemplar_raw)
+            if em is None:
+                errors.append(
+                    f"line {lineno}: malformed exemplar {exemplar_raw!r}")
+            elif not (name.endswith("_bucket") or name.endswith("_total")):
+                errors.append(
+                    f"line {lineno}: exemplar on non-bucket/counter "
+                    f"sample {name!r}")
+            else:
+                try:
+                    exemplar_value = float(em.group(2))
+                except ValueError:
+                    errors.append(
+                        f"line {lineno}: bad exemplar value "
+                        f"{em.group(2)!r}")
         if not _NAME_RE.match(name):
             errors.append(f"line {lineno}: illegal metric name {name!r}")
             continue
@@ -147,6 +200,11 @@ def validate(text: str) -> List[str]:
                 errors.append(
                     f"line {lineno}: bad le value {labels['le']!r}")
                 continue
+            if (exemplar_value is not None and le != math.inf
+                    and exemplar_value > le):
+                errors.append(
+                    f"line {lineno}: exemplar value {exemplar_value} "
+                    f"exceeds bucket le={labels['le']}")
             buckets.setdefault(name[:-len("_bucket")], []).append(
                 (le, value))
         else:

@@ -125,6 +125,34 @@ class MetricsRegistry:
             else:
                 h["counts"][-1] += 1  # +Inf overflow bucket
 
+    def observe_exemplar(self, name: str, value: float,
+                         trace_id: str) -> None:
+        """Attach an exemplar trace id to the bucket ``value`` lands in.
+
+        Exemplars link a histogram bucket to a concrete trace
+        (OpenMetrics ``# {trace_id="..."} value``).  Storage is bounded
+        by construction — at most one exemplar per bucket, newest wins
+        with a preference for slower samples within the bucket so the
+        worst representative survives.  No-op for unknown histograms
+        (exemplars never create series).
+        """
+        if not trace_id:
+            return
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                return
+            for i, bound in enumerate(h["bounds"]):
+                if value <= bound:
+                    idx = i
+                    break
+            else:
+                idx = len(h["bounds"])  # +Inf overflow bucket
+            ex = h.setdefault("exemplars", {})
+            prev = ex.get(idx)
+            if prev is None or value >= prev["value"]:
+                ex[idx] = {"trace_id": str(trace_id), "value": float(value)}
+
     def ensure_histogram(self, name: str,
                          buckets: Optional[Sequence[float]] = None) -> None:
         """Register an empty histogram so it exports before first use."""
@@ -149,12 +177,18 @@ class MetricsRegistry:
 
     def histograms(self) -> Dict[str, dict]:
         """Snapshot: {name: {bounds, counts (per-bucket, +Inf last),
-        sum, count}} — the shape the original trace.py exported."""
+        sum, count[, exemplars]}} — the shape the original trace.py
+        exported, plus per-bucket exemplars when any were attached."""
         with self._lock:
-            return {k: {"bounds": v["bounds"],
-                        "counts": list(v["counts"]),
-                        "count": v["count"], "sum": v["sum"]}
-                    for k, v in self._hists.items()}
+            out = {}
+            for k, v in self._hists.items():
+                row = {"bounds": v["bounds"], "counts": list(v["counts"]),
+                       "count": v["count"], "sum": v["sum"]}
+                if v.get("exemplars"):
+                    row["exemplars"] = {i: dict(e)
+                                        for i, e in v["exemplars"].items()}
+                out[k] = row
+            return out
 
     # --------------------------------------------------------- reset ---
 
@@ -198,6 +232,10 @@ def counters() -> Dict[str, int]:
 def observe(name: str, value: float,
             buckets: Optional[Sequence[float]] = None) -> None:
     _reg().observe(name, value, buckets)
+
+
+def observe_exemplar(name: str, value: float, trace_id: str) -> None:
+    _reg().observe_exemplar(name, value, trace_id)
 
 
 def ensure_histogram(name: str,

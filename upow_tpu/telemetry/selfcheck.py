@@ -36,6 +36,19 @@ REQUIRED = (
     "upow_archive_archived_txs",
     "upow_archive_hot_rows_pruned",
     "upow_archive_fallthrough_reads",
+    # watchtower alert families (docs/ALERTING.md) — emitted as zeros
+    # even when WatchtowerConfig.enabled is off, so a bare node still
+    # carries them and dashboards never see a family appear from nowhere
+    "upow_alert_firing",
+    "upow_alert_pending",
+    "upow_alert_silenced",
+    "upow_alert_exemplars_firing",
+    "upow_alert_eval_lag_seconds",
+    "upow_alert_evaluations_total",
+    "upow_alert_fired_total",
+    "upow_alert_resolved_total",
+    # incremental /debug/events cursor-loss counter (telemetry/events.py)
+    "upow_telemetry_events_rotated_unseen_total",
 )
 
 #: families the merged fleet rendering must always carry

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, Optional
 
-from . import metrics
+from . import metrics, tracing
 
 #: HTTP buckets: finer than the span default at the fast end (an
 #: in-process cached read answers in tens of microseconds) while still
@@ -50,11 +50,23 @@ def preregister(endpoints: Iterable[str]) -> None:
         metrics.ensure_counter(_PREFIX + ep + ".errors")
 
 
-def observe_request(endpoint: str, seconds: float,
-                    status: int = 200) -> None:
-    """Record one served request against ``endpoint``'s SLO series."""
+def observe_request(endpoint: str, seconds: float, status: int = 200,
+                    trace_id: Optional[str] = None) -> None:
+    """Record one served request against ``endpoint``'s SLO series.
+
+    When the request ran under a trace, the trace id is attached as a
+    bucket exemplar — /metrics then links the latency bucket to the
+    concrete (possibly cross-node) trace that produced it, and firing
+    alerts pick the same ids up as incident exemplars.  Callers that
+    measure *after* their trace context closed (the node middleware
+    times the full handler) pass the id explicitly; inside a live
+    trace the ambient id is picked up automatically."""
     ep = _safe(endpoint)
-    metrics.observe(_PREFIX + ep + _SUFFIX, seconds, LATENCY_BUCKETS)
+    name = _PREFIX + ep + _SUFFIX
+    metrics.observe(name, seconds, LATENCY_BUCKETS)
+    tid = trace_id or tracing.current_trace_id()
+    if tid:
+        metrics.observe_exemplar(name, seconds, tid)
     metrics.inc(_PREFIX + ep + ".requests")
     if status >= 500:
         metrics.inc(_PREFIX + ep + ".errors")
