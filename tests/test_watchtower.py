@@ -153,6 +153,36 @@ def test_burnrate_none_without_baseline_or_traffic():
         "zero requests inside the window is idleness, not an outage"
 
 
+def test_burnrate_production_windows_survive_retention():
+    """Regression: at production scale (window_scale=1.0) and the 5 s
+    default cadence the 6 h baseline must survive snapshot retention —
+    a fixed 512-snapshot ring retained ~43 min, so the long windows
+    always answered None and page/ticket could never go true outside
+    scaled tests."""
+    ev = BurnRateEvaluator(slo_target=0.999, window_scale=1.0)
+    req = err = t = 0.0
+    for _ in range(int(7 * 3600 / 5)):            # 7 h of 5 s ticks,
+        t += 5.0                                  # 50% errors: 500x burn
+        req += 100.0
+        err += 50.0
+        ev.record(t, {"push_tx": (req, err)})
+    res = ev.evaluate(t)["push_tx"]
+    assert res["fast_long"] is not None and res["slow_long"] is not None
+    assert res["page"] and res["ticket"]
+    # retention is time-bounded: ~6 h of ticks plus the one baseline
+    # snapshot at-or-before the window start, never the whole feed
+    assert len(ev._snaps) <= int(6 * 3600 / 5) + 2
+
+
+def test_engine_sizes_burn_backstop_from_windows():
+    """The engine's snapshot-count backstop derives from the longest
+    window and the cadence, with slack — never a fixed constant that
+    silently undercuts the slow pair."""
+    cfg = WatchtowerConfig(enabled=True)          # 5 s, scale 1.0
+    eng = WatchtowerEngine(cfg, scope=TelemetryScope("bs"))
+    assert eng._burn._snaps.maxlen >= int(6 * 3600 / 5)
+
+
 # ------------------------------------------------- alert state machine ----
 
 def test_alert_for_duration_exemplar_dedup_and_resolve():
@@ -228,6 +258,23 @@ def test_scoped_since_bumps_rotated_unseen_counter():
             "a cursor that kept up adds nothing"
 
 
+def test_event_ring_seq_monotonic_across_reset():
+    """Regression: reset() must not rewind the sequence — a consumer
+    holding a cursor across a reset would otherwise silently drop every
+    post-reset event whose re-used seq falls at or below its cursor."""
+    ring = EventRing(maxlen=8)
+    for i in range(5):
+        ring.emit("k", i=i)
+    cursor = ring.since(0)["next_seq"]
+    assert cursor == 5
+    ring.reset()
+    ring.emit("k", i=99)
+    got = ring.since(cursor)
+    assert [e["i"] for e in got["events"]] == [99], \
+        "a stale cursor still sees events emitted after reset()"
+    assert got["events"][0]["seq"] == 6 and got["next_seq"] == 6
+
+
 # -------------------------------------------------- exposition exemplars ----
 
 def test_histogram_exemplar_renders_and_validates():
@@ -277,6 +324,26 @@ def test_validator_rejects_exemplar_beyond_bucket_bound():
            'm_count 1\n')
     errs = exposition.validate(bad)
     assert any("exceeds bucket" in e for e in errs), errs
+
+
+def test_validator_exemplar_split_honors_quoted_labels():
+    """Regression: the exemplar separator only counts outside the label
+    set — a quoted label value legitimately containing ' # {' (only
+    backslash/quote/newline are escaped) must not be mis-split into a
+    bogus exemplar."""
+    sneaky = 'm_total{route="a # {b"} 3\n'
+    assert exposition.validate(sneaky) == []
+    # ...and a real exemplar after such a label still parses + checks
+    both = ('m_bucket{le="0.1",note="x # {y"} 1 '
+            '# {trace_id="abc"} 0.05\n'
+            'm_bucket{le="+Inf",note="x # {y"} 1\n'
+            'm_sum 0.050000\n'
+            'm_count 1\n')
+    assert exposition.validate(both) == []
+    # an exemplar on a label-less counter sample is still recognised
+    bad = 'm_total 3 # {trace_id="abc" 0.05\n'      # unclosed label set
+    assert any("malformed exemplar" in e
+               for e in exposition.validate(bad))
 
 
 # --------------------------------------------------------- engine unit ----
@@ -432,10 +499,19 @@ def test_debug_alerts_metrics_families_and_events_cursor(tmp_path, keys):
         assert {x["name"] for x in r["rules"]} >= {
             "verify_throughput_collapse", "breaker_flip_storm",
             "slo_burn_fast", "slo_burn_slow", "stuck_height"}
+        # knobs are POST-only; a GET with knob params stays read-only
         res = await (await client.get(
             "/debug/alerts", params={"silence": "stuck_height",
                                      "seconds": "60"})).json()
+        assert "actions" not in res["result"]
+        assert res["result"]["counts"]["silenced"] == 0
+        res = await (await client.post(
+            "/debug/alerts", params={"silence": "stuck_height",
+                                     "seconds": "60"})).json()
         assert res["result"]["actions"] == {"silenced": "stuck_height"}
+        res = await (await client.post(
+            "/debug/alerts", json={"unsilence": "stuck_height"})).json()
+        assert res["result"]["actions"] == {"unsilenced": "stuck_height"}
 
         text = await (await client.get("/metrics")).text()
         for family in ("upow_alert_firing ", "upow_alert_pending ",
@@ -447,13 +523,17 @@ def test_debug_alerts_metrics_families_and_events_cursor(tmp_path, keys):
             "SLO bucket exemplars must render on /metrics"
         assert exposition.validate(text) == []
 
+        # seq is monotonic across telemetry.reset(), so a since=0 poll
+        # may honestly report pre-reset events as missed; assert the
+        # cursor flow relative to the ring's own sequence instead.
         res = await (await client.get(
             "/debug/events", params={"since": "0"})).json()
-        assert res["ok"] and "next_seq" in res and res["missed"] == 0
+        assert res["ok"] and "next_seq" in res and "missed" in res
         cursor = res["next_seq"]
         res = await (await client.get(
             "/debug/events", params={"since": str(cursor)})).json()
-        assert res["result"] == []
+        assert res["result"] == [] and res["missed"] == 0, \
+            "a caught-up cursor sees nothing new and missed nothing"
         res = await client.get("/debug/events", params={"since": "x"})
         assert res.status == 400
 

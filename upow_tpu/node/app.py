@@ -1089,35 +1089,62 @@ class Node:
                                                 kind=kind)})
 
     async def h_debug_alerts(self, request: web.Request) -> web.Response:
-        """Watchtower surface (docs/ALERTING.md): the rule pack, active
-        alert states with exemplar trace ids, the firing/resolved
-        history ring, burn-rate readings, and operator knobs —
-        ``?silence=<key>&seconds=<s>``, ``?unsilence=<key>``,
-        ``?ack=<key>``.  ``{"enabled": false}`` when the watchtower is
-        off (UPOW_WATCHTOWER_ENABLED=1 turns it on)."""
+        """Watchtower surface (docs/ALERTING.md), read-only: the rule
+        pack, active alert states with exemplar trace ids, the
+        firing/resolved history ring, and burn-rate readings.
+        ``{"enabled": false}`` when the watchtower is off
+        (UPOW_WATCHTOWER_ENABLED=1 turns it on).  The operator knobs
+        (silence/unsilence/ack) live on POST — a side-effecting GET
+        could be triggered by any prefetcher or dashboard refresh."""
         wt = self.watchtower
         if wt is None:
             return web.json_response(
                 {"ok": True, "result": {"enabled": False}})
-        q = request.rel_url.query
+        result = wt.snapshot()
+        result["enabled"] = True
+        return web.json_response({"ok": True, "result": result})
+
+    async def h_debug_alerts_post(self,
+                                  request: web.Request) -> web.Response:
+        """Watchtower operator knobs: ``silence=<key>&seconds=<s>``,
+        ``unsilence=<key>``, ``ack=<key>`` — as query parameters or a
+        JSON body (body wins).  Answers the post-action snapshot plus
+        an ``actions`` record of what was applied."""
+        wt = self.watchtower
+        if wt is None:
+            return web.json_response(
+                {"ok": True, "result": {"enabled": False}})
+        q = dict(request.rel_url.query)
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except ValueError:
+                return web.json_response(
+                    {"ok": False, "error": "body must be JSON"},
+                    status=400)
+            if not isinstance(body, dict):
+                return web.json_response(
+                    {"ok": False, "error": "body must be a JSON object"},
+                    status=400)
+            q.update({k: v for k, v in body.items() if v is not None})
         actions = {}
         key = q.get("silence")
         if key:
             try:
-                secs = float(q.get("seconds", "300"))
-            except ValueError:
+                secs = float(q.get("seconds", 300))
+            except (TypeError, ValueError):
                 return web.json_response(
                     {"ok": False, "error": "seconds must be a number"},
                     status=400)
-            wt.silence(key, secs)
+            wt.silence(str(key), secs)
             actions["silenced"] = key
         key = q.get("unsilence")
         if key:
-            wt.alerts.unsilence(key)
+            wt.alerts.unsilence(str(key))
             actions["unsilenced"] = key
         key = q.get("ack")
         if key:
-            actions["acked"] = wt.ack(key)
+            actions["acked"] = wt.ack(str(key))
         result = wt.snapshot()
         result["enabled"] = True
         if actions:
@@ -2414,6 +2441,7 @@ class Node:
             r.add_get("/debug/traces", self.h_debug_traces)
             r.add_get("/debug/events", self.h_debug_events)
             r.add_get("/debug/alerts", self.h_debug_alerts)
+            r.add_post("/debug/alerts", self.h_debug_alerts_post)
             r.add_get("/debug/breakers", self.h_debug_breakers)
             r.add_get("/debug/cache", self.h_debug_cache)
             r.add_get("/debug/archive", self.h_debug_archive)

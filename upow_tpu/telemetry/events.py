@@ -86,9 +86,13 @@ class EventRing:
         return {"events": out, "next_seq": last, "missed": missed}
 
     def reset(self) -> None:
+        """Drop retained records; ``_seq`` stays monotonic.  Zeroing it
+        would strand consumers holding a cursor (the watchtower engine,
+        ``?since=`` pollers): post-reset events re-use already-consumed
+        sequence numbers, so ``since()`` filters them out silently until
+        the cursor happens to catch up again."""
         with self._lock:
             self._events.clear()
-            self._seq = 0
 
 
 _global = EventRing()

@@ -140,6 +140,42 @@ def _parse_le(raw: str) -> float:
     return float(raw)
 
 
+def _split_exemplar(line: str) -> Tuple[str, Optional[str]]:
+    """Split a sample line into (sample, exemplar_raw or None).
+
+    The ``' # {'`` separator only counts *outside* the label set: a
+    quoted label value may legitimately contain it (only backslash,
+    quote and newline are escaped), so scanning starts after the label
+    set's closing ``}`` — found by walking the braces quote- and
+    escape-aware, not by ``find``."""
+    space = line.find(" ")
+    brace = line.find("{")
+    start = 0
+    if brace != -1 and (space == -1 or brace < space):
+        # a label set opens directly after the name (no space before
+        # it); any later '{' belongs to an exemplar or a label value
+        i, in_str, esc = brace + 1, False, False
+        while i < len(line):
+            ch = line[i]
+            if in_str:
+                if esc:
+                    esc = False
+                elif ch == "\\":
+                    esc = True
+                elif ch == '"':
+                    in_str = False
+            elif ch == '"':
+                in_str = True
+            elif ch == "}":
+                start = i + 1
+                break
+            i += 1
+    cut = line.find(" # {", start)
+    if cut == -1:
+        return line, None
+    return line[:cut], line[cut + 3:]
+
+
 def validate(text: str) -> List[str]:
     """Return a list of format violations ([] == clean)."""
     errors: List[str] = []
@@ -157,11 +193,7 @@ def validate(text: str) -> List[str]:
                 errors.append(
                     f"line {lineno}: illegal metric name {parts[2]!r}")
             continue
-        exemplar_raw = None
-        cut = line.find(" # {")
-        if cut != -1:
-            exemplar_raw = line[cut + 3:]
-            line = line[:cut]
+        line, exemplar_raw = _split_exemplar(line)
         m = _SAMPLE_RE.match(line)
         if m is None:
             errors.append(f"line {lineno}: unparseable sample {line!r}")

@@ -12,9 +12,14 @@ paging.
 The evaluator is fed cumulative per-route request/error counts (from
 the scope's ``slo.http.<route>.requests`` / ``.errors`` counters) at
 each evaluation tick and answers burn rates over trailing windows by
-diffing against a ring of retained snapshots.  ``window_scale``
-compresses the canonical windows so tests and seeded scenarios can
-exercise the math in milliseconds; production keeps 1.0.
+diffing against a ring of retained snapshots.  Retention is
+time-bounded by the longest configured window (the slow pair's 6 h
+long window at the current ``window_scale``) rather than
+count-bounded, so the long-window baseline always survives no matter
+the feed cadence; ``max_snapshots`` is only an optional hard backstop
+against pathologically fast feeders.  ``window_scale`` compresses the
+canonical windows so tests and seeded scenarios can exercise the math
+in milliseconds; production keeps 1.0.
 """
 
 from __future__ import annotations
@@ -34,7 +39,8 @@ class BurnRateEvaluator:
 
     def __init__(self, slo_target: float = 0.999,
                  fast_burn: float = 14.4, slow_burn: float = 6.0,
-                 window_scale: float = 1.0, max_snapshots: int = 512) -> None:
+                 window_scale: float = 1.0,
+                 max_snapshots: Optional[int] = None) -> None:
         if not 0.0 < slo_target < 1.0:
             raise ValueError(f"slo_target out of range: {slo_target}")
         self.slo_target = float(slo_target)
@@ -42,17 +48,32 @@ class BurnRateEvaluator:
         self.fast_burn = float(fast_burn)
         self.slow_burn = float(slow_burn)
         self.window_scale = float(window_scale)
-        # ring of (ts, {route: (requests, errors)})
+        # ring of (ts, {route: (requests, errors)}); time-pruned in
+        # record(), maxlen only as an optional overflow backstop
         self._snaps: deque = deque(maxlen=max_snapshots)
 
     def window(self, pair: str) -> Tuple[float, float]:
         short, long_ = WINDOWS[pair]
         return short * self.window_scale, long_ * self.window_scale
 
+    def retention(self) -> float:
+        """Longest trailing window, seconds — the slow pair's long
+        window at the current scale.  Snapshots older than this (bar
+        one baseline) can never be read by burn()."""
+        return max(long_ for _, long_ in WINDOWS.values()) * self.window_scale
+
     def record(self, now: float,
                counts: Dict[str, Tuple[float, float]]) -> None:
         """Retain one snapshot of cumulative (requests, errors) by route."""
-        self._snaps.append((float(now), dict(counts)))
+        now = float(now)
+        self._snaps.append((now, dict(counts)))
+        # Prune by age, not count: always keep exactly one snapshot
+        # at-or-before the longest window's start so the 6 h baseline
+        # survives regardless of feed cadence (a count cap at a 5 s
+        # cadence retains ~43 min and the slow pair never evaluates).
+        horizon = now - self.retention()
+        while len(self._snaps) >= 2 and self._snaps[1][0] <= horizon:
+            self._snaps.popleft()
 
     def _at_or_before(self, ts: float) -> Optional[Tuple[float, dict]]:
         """Newest retained snapshot with snap_ts <= ts (window start)."""

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import math
 import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
@@ -41,7 +42,7 @@ from ..telemetry import metrics as metrics_mod
 from ..telemetry import tracing as tracing_mod
 from ..telemetry.events import ROTATED_UNSEEN
 from .alerts import AlertManager, AlertRule
-from .burnrate import BurnRateEvaluator
+from .burnrate import WINDOWS, BurnRateEvaluator
 from .detectors import EwmaZScore, RateTracker, SpikeDetector, StuckGauge
 from . import benchlog
 
@@ -70,9 +71,19 @@ class WatchtowerEngine:
         self._probes: Dict[str, Callable] = {}
         self._mgr = AlertManager(history=cfg.history,
                                  emit=self._on_transition)
+        # Snapshot backstop sized from the windows: one snapshot per
+        # tick across the longest (6 h) window plus slack.  The
+        # evaluator prunes by age; the cap only guards a runaway feeder
+        # and must never undercut the long-window baseline (a fixed 512
+        # cap at the 5 s default retained ~43 min, so the slow burn
+        # pair — and paging — could never evaluate in production).
+        retention = (max(l for _, l in WINDOWS.values())
+                     * cfg.window_scale)
         self._burn = BurnRateEvaluator(
             slo_target=cfg.slo_target, fast_burn=cfg.fast_burn,
-            slow_burn=cfg.slow_burn, window_scale=cfg.window_scale)
+            slow_burn=cfg.slow_burn, window_scale=cfg.window_scale,
+            max_snapshots=math.ceil(
+                retention / max(cfg.interval, 1e-6)) + 16)
         # streaming detector state
         self._verify_rate = RateTracker()
         # min_sigma floors the z denominator: a perfectly steady rate
